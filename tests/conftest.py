@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU platform so the multi-device and
+multi-host tiers are exercised without TPU hardware (SURVEY.md §4's
+fake-multi-host strategy; cf. the reference's oversubscribed-locale smoke
+testing via CHPL_COMM_SUBSTRATE=udp, `g5k_dist_multigpu_nvidia.sh:33`).
+Environment must be set before jax is first imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,116 @@
+"""Oracle bound sanity and internal-consistency properties.
+
+The numpy oracles are themselves validated here by invariants the C library
+satisfies (`c_bound_simple.c`, `c_bound_johnson.c`); the device kernels are
+then compared against the oracles in test_device_kernels.py.
+"""
+
+import numpy as np
+
+from tpu_tree_search.problems.pfsp import bounds as B
+from tpu_tree_search.problems.pfsp import taillard as T
+
+
+def _random_node(rng, jobs):
+    prmu = rng.permutation(jobs).astype(np.int32)
+    limit1 = int(rng.integers(-1, jobs - 1))
+    return prmu, limit1
+
+
+def test_lb1_leaf_equals_makespan():
+    """lb1 of a complete permutation equals its makespan (SURVEY.md App. A)."""
+    ptm = T.reduced_instance(14, jobs=10, machines=10)
+    d = B.make_lb1(ptm)
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        prmu = rng.permutation(10).astype(np.int32)
+        assert B.lb1_bound(d, prmu, 9, 10) == B.eval_solution(d, prmu)
+
+
+def test_lb1_is_lower_bound():
+    """Any completion of the prefix has makespan >= lb1 of the node."""
+    ptm = T.reduced_instance(3, jobs=7, machines=5)
+    d = B.make_lb1(ptm)
+    rng = np.random.default_rng(1)
+    for _ in range(50):
+        prmu, limit1 = _random_node(rng, 7)
+        lb = B.lb1_bound(d, prmu, limit1, 7)
+        # complete randomly several times
+        for _ in range(5):
+            tail = prmu[limit1 + 1 :].copy()
+            rng.shuffle(tail)
+            full = np.concatenate([prmu[: limit1 + 1], tail])
+            assert B.eval_solution(d, full) >= lb
+
+
+def test_lb2_dominates_lb1():
+    """lb2 (max over machine pairs incl. adjacent ones with full Johnson) is
+    at least as strong as any single 2-machine relaxation it contains; both
+    must stay below the true makespan. Without early exit lb2 >= lb1 is not
+    guaranteed in general, but both are valid lower bounds."""
+    ptm = T.reduced_instance(14, jobs=8, machines=5)
+    d1 = B.make_lb1(ptm)
+    d2 = B.make_lb2(d1)
+    rng = np.random.default_rng(2)
+    big = 10**9
+    for _ in range(30):
+        prmu, limit1 = _random_node(rng, 8)
+        lb2 = B.lb2_bound(d1, d2, prmu, limit1, 8, big)
+        for _ in range(5):
+            tail = prmu[limit1 + 1 :].copy()
+            rng.shuffle(tail)
+            full = np.concatenate([prmu[: limit1 + 1], tail])
+            assert B.eval_solution(d1, full) >= lb2
+
+
+def test_lb2_early_exit_consistency():
+    """Early exit returns a value > min_cmax iff the full bound is (the prune
+    decision is unchanged) — the property the TPU kernel relies on to drop
+    the exit (`c_bound_johnson.c:231-234`)."""
+    ptm = T.reduced_instance(21, jobs=8, machines=8)
+    d1 = B.make_lb1(ptm)
+    d2 = B.make_lb2(d1)
+    rng = np.random.default_rng(3)
+    for _ in range(50):
+        prmu, limit1 = _random_node(rng, 8)
+        full = B.lb2_bound(d1, d2, prmu, limit1, 8, 10**9)
+        for cutoff in (full - 7, full - 1, full, full + 3):
+            exited = B.lb2_bound(d1, d2, prmu, limit1, 8, cutoff)
+            assert (exited > cutoff) == (full > cutoff)
+            if exited <= cutoff:
+                assert exited == full
+
+
+def test_children_bounds_match_add_front():
+    """lb1_children_bounds agrees with per-child add_front_and_bound
+    (`c_bound_simple.c:160-211`)."""
+    ptm = T.reduced_instance(14, jobs=9, machines=7)
+    d = B.make_lb1(ptm)
+    rng = np.random.default_rng(4)
+    for _ in range(20):
+        prmu, limit1 = _random_node(rng, 9)
+        lb_begin = B.lb1_children_bounds(d, prmu, limit1, 9)
+        front = B.schedule_front(d, prmu, limit1)
+        back = B.schedule_back(d, prmu, 9)
+        remain = B.sum_unscheduled(d, prmu, limit1, 9)
+        for i in range(limit1 + 1, 9):
+            job = int(prmu[i])
+            assert lb_begin[job] == B.add_front_and_bound(d, job, front, back, remain)
+
+
+def test_min_heads_tails_follow_c_semantics():
+    """Regression guard for the Chapel min-heads port bug (SURVEY.md §2.1,
+    `Bound_simple.chpl:271` vs `c_bound_simple.c:300`): heads must be the
+    min over jobs of the cumulative head, not clipped at int32 max."""
+    ptm = T.reduced_instance(14, jobs=6, machines=4)
+    d = B.make_lb1(ptm)
+    p = ptm.astype(np.int64)
+    m, n = p.shape
+    expect_heads = np.zeros(m, dtype=np.int64)
+    for k in range(1, m):
+        expect_heads[k] = min(p[:k, j].sum() for j in range(n))
+    expect_tails = np.zeros(m, dtype=np.int64)
+    for k in range(m - 1):
+        expect_tails[k] = min(p[k + 1 :, j].sum() for j in range(n))
+    assert np.array_equal(d.min_heads, expect_heads)
+    assert np.array_equal(d.min_tails, expect_tails)

@@ -1,0 +1,46 @@
+"""Taillard generator parity.
+
+Golden checksums were produced by compiling the reference C generator
+(`baselines/pfsp/lib/c_taillard.c`) and dumping (jobs, machines, sum, first
+rows); the LCG's float32 division makes these bit-exact invariants.
+"""
+
+import numpy as np
+
+from tpu_tree_search.problems.pfsp import taillard as T
+
+# inst -> (jobs, machines, total_sum, first 10 flat values)
+GOLDEN = {
+    1: (20, 5, 5153, [54, 83, 15, 71, 77, 36, 53, 38, 27, 87]),
+    14: (20, 10, 8930, [94, 43, 6, 47, 45, 51, 73, 49, 31, 58]),
+    21: (20, 20, 20273, [50, 90, 39, 34, 66, 81, 27, 48, 46, 68]),
+    31: (50, 5, 12077, [75, 87, 13, 11, 41, 43, 93, 69, 80, 13]),
+    114: (500, 20, 500754, [3, 94, 39, 10, 2, 66, 26, 6, 83, 12]),
+}
+
+
+def test_sizes_and_checksums():
+    for inst, (jobs, machines, total, head) in GOLDEN.items():
+        ptm = T.processing_times(inst)
+        assert T.nb_jobs(inst) == jobs
+        assert T.nb_machines(inst) == machines
+        assert ptm.shape == (machines, jobs)
+        assert int(ptm.sum()) == total
+        assert list(ptm.ravel()[:10]) == head
+        assert ptm.min() >= 1 and ptm.max() <= 99
+
+
+def test_best_ub_table():
+    assert T.best_ub(14) == 1377
+    assert T.best_ub(1) == 1278
+    assert T.best_ub(21) == 2297
+    assert T.best_ub(30) == 2178
+    assert T.best_ub(120) == 26457
+    assert len(T.OPTIMAL_MAKESPANS) == 120 and len(T.TIME_SEEDS) == 120
+
+
+def test_reduced_instance():
+    r = T.reduced_instance(14, jobs=8, machines=5)
+    full = T.processing_times(14)
+    assert r.shape == (5, 8)
+    assert np.array_equal(r, full[:5, :8])

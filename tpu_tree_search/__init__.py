@@ -1,0 +1,23 @@
+"""tpu-tree-search: a TPU-native framework for accelerated tree search.
+
+Re-implements, TPU-first, the capabilities of the Chapel/CUDA reference
+`Guillaume-Helbecque/GPU-accelerated-tree-search-Chapel`: multi-pool
+depth-first backtracking / Branch-and-Bound whose batched node evaluations
+(N-Queens safety checks, PFSP lb1/lb1_d/lb2 lower bounds) run as XLA/Pallas
+kernels on TPU chips, with four scaling tiers (sequential, single-device,
+multi-device, multi-host) instead of the reference's eight copy-pasted
+programs (see SURVEY.md §1).
+
+Layout:
+  problems/  problem plugins (N-Queens, PFSP) + numpy oracle bounds
+  ops/       device kernels (vectorized jnp + Pallas)
+  pool/      host-side work pools (SoA deque, lock-based parallel variant,
+             optional C++ native backend)
+  engine/    search drivers: sequential, chunked-offload device, fused
+             on-device (lax.while_loop)
+  parallel/  multi-device runtime (work stealing, termination) and
+             mesh/multi-host tier (jax.sharding + collectives)
+  utils/     termination detection, diagnostics counters, config
+"""
+
+__version__ = "0.1.0"

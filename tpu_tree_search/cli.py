@@ -1,0 +1,177 @@
+"""Command-line entry point — one program instead of the reference's eight.
+
+``tts <problem> --tier seq|device|multi|dist [flags]`` replaces the
+per-(problem, tier) Chapel mains (`README.md:47-88` of the reference). Flags
+and defaults match the reference's config consts: ``--N --g`` for N-Queens
+(`nqueens_chpl.chpl:15-16`), ``--inst --lb --ub`` for PFSP
+(`pfsp_chpl.chpl:20-22`), ``--m --M`` chunk thresholds and ``--D`` device
+count for the offload tiers (`nqueens_gpu_chpl.chpl:12-21`,
+`README.md:47-58`). The banner/report format mirrors `print_settings` /
+`print_results` (`pfsp_chpl.chpl:54-77`) plus the per-phase breakdown and
+offload diagnostics of the device tiers (`nqueens_gpu_chpl.chpl:178-283`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tts", description="TPU-native accelerated tree search"
+    )
+    sub = p.add_subparsers(dest="problem", required=True)
+
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument(
+        "--tier",
+        choices=["seq", "device", "multi", "dist"],
+        default="seq",
+        help="scaling tier (sequential / single-device / multi-device / multi-host)",
+    )
+    common.add_argument("--m", type=int, default=25, help="minimum chunk size")
+    common.add_argument("--M", type=int, default=50000, help="maximum chunk size")
+    common.add_argument("--D", type=int, default=1, help="number of devices (multi tier)")
+    common.add_argument("--stats-file", type=str, default=None,
+                        help="append one result line to this .dat file")
+    common.add_argument("--json", action="store_true", help="emit one JSON result line")
+
+    nq = sub.add_parser("nqueens", parents=[common], help="N-Queens backtracking")
+    nq.add_argument("--N", type=int, default=14, help="number of queens")
+    nq.add_argument("--g", type=int, default=1, help="safety checks per evaluation")
+
+    pf = sub.add_parser("pfsp", parents=[common], help="PFSP Branch-and-Bound")
+    pf.add_argument("--inst", type=int, default=14, help="Taillard instance (1..120)")
+    pf.add_argument("--lb", type=str, default="lb1", choices=["lb1", "lb1_d", "lb2"])
+    pf.add_argument("--ub", type=int, default=1, choices=[0, 1],
+                    help="initial upper bound: 1=known optimum, 0=inf")
+    return p
+
+
+def make_problem(args):
+    if args.problem == "nqueens":
+        from .problems import NQueensProblem
+
+        return NQueensProblem(N=args.N, g=args.g)
+    from .problems import PFSPProblem
+
+    return PFSPProblem(inst=args.inst, lb=args.lb, ub=args.ub)
+
+
+def run_tier(problem, args):
+    if args.tier == "seq":
+        from .engine import sequential_search
+
+        return sequential_search(problem)
+    if args.tier == "device":
+        from .engine.device import device_search
+
+        return device_search(problem, m=args.m, M=args.M)
+    if args.tier == "multi":
+        from .parallel.multidevice import multidevice_search
+
+        return multidevice_search(problem, m=args.m, M=args.M, D=args.D)
+    from .parallel.dist import dist_search
+
+    return dist_search(problem, m=args.m, M=args.M, D=args.D)
+
+
+def print_settings(args) -> None:
+    print("\n=================================================")
+    tier_names = {
+        "seq": "Sequential",
+        "device": "Single-device",
+        "multi": "Multi-device",
+        "dist": "Distributed multi-device",
+    }
+    print(f"{tier_names[args.tier]} TPU tree search\n")
+    if args.problem == "nqueens":
+        print(f"Resolution of the {args.N}-Queens instance")
+        print(f"  with {args.g} safety check(s) per evaluation")
+    else:
+        from .problems.pfsp import taillard
+
+        print(
+            f"Resolution of PFSP Taillard's instance: ta{args.inst:03d} "
+            f"(m = {taillard.nb_machines(args.inst)}, n = {taillard.nb_jobs(args.inst)})"
+        )
+        print("Initial upper bound: " + ("opt" if args.ub == 1 else "inf"))
+        print(f"Lower bound function: {args.lb}")
+        print("Branching rule: fwd")
+    print("=================================================")
+
+
+def print_results(args, problem, res) -> None:
+    for i, ph in enumerate(res.phases[:3], 1):
+        label = {1: "Initial search on CPU", 2: "Search on device", 3: "Final search on CPU"}.get(
+            i, f"Phase {i}"
+        )
+        if len(res.phases) > 1:
+            print(f"\n{label} completed")
+            print(f"Size of the explored tree: {ph.tree}")
+            print(f"Number of explored solutions: {ph.sol}")
+            print(f"Elapsed time: {ph.seconds:.6f} [s]")
+    print("\nExploration terminated.")
+    print("\n=================================================")
+    print(f"Size of the explored tree: {res.explored_tree}")
+    print(f"Number of explored solutions: {res.explored_sol}")
+    if args.problem == "pfsp":
+        tag = " (improved)" if res.best < problem.initial_ub else " (not improved)"
+        print(f"Optimal makespan: {res.best}{tag}")
+    print(f"Elapsed time: {res.elapsed:.6f} [s]")
+    if res.per_worker_tree:
+        shares = ", ".join(f"{s:.2f}" for s in res.workload_shares())
+        print(f"Workload per device (%): [{shares}]")
+    d = res.diagnostics
+    if d.kernel_launches:
+        print(
+            f"Device diagnostics: kernel_launch={d.kernel_launches} "
+            f"host_to_device={d.host_to_device} device_to_host={d.device_to_host}"
+        )
+    print("=================================================\n")
+
+
+def result_record(args, res) -> dict:
+    rec = {
+        "problem": args.problem,
+        "tier": args.tier,
+        "explored_tree": res.explored_tree,
+        "explored_sol": res.explored_sol,
+        "elapsed_s": round(res.elapsed, 6),
+    }
+    if args.problem == "pfsp":
+        rec.update(inst=args.inst, lb=args.lb, ub=args.ub, optimum=res.best)
+    else:
+        rec.update(N=args.N, g=args.g)
+    return rec
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        problem = make_problem(args)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+    print_settings(args)
+    try:
+        res = run_tier(problem, args)
+    except (ModuleNotFoundError, NotImplementedError) as e:
+        print(f"Error: tier '{args.tier}' unavailable: {e}", file=sys.stderr)
+        return 2
+    print_results(args, problem, res)
+    rec = result_record(args, res)
+    if args.json:
+        print(json.dumps(rec))
+    if args.stats_file:
+        # Append-only stats line, like `stats_pfsp_gpu_cuda.dat`
+        # (`pfsp_gpu_cuda.c:140-148`).
+        with open(args.stats_file, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Search drivers: sequential, chunked single-device, fused on-device."""
+
+from .results import SearchResult
+from .sequential import sequential_search
+
+__all__ = ["SearchResult", "sequential_search"]

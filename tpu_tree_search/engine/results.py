@@ -1,0 +1,51 @@
+"""Search result/report structures.
+
+Reproduces the reference's self-reported metrics (SURVEY.md §6): exploredTree,
+exploredSol, optimum, elapsed time, the 3-phase breakdown of the offload
+tiers (`nqueens_gpu_chpl.chpl:178-245`), and offload diagnostics counters
+(GpuDiagnostics equivalent, `pfsp_gpu_chpl.chpl:454-466`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PhaseStats:
+    """One phase's deltas (`res1/res2/res3`, `nqueens_gpu_chpl.chpl:178-245`)."""
+
+    seconds: float = 0.0
+    tree: int = 0
+    sol: int = 0
+
+
+@dataclass
+class Diagnostics:
+    """Offload counters (Chapel GpuDiagnostics: kernel_launch /
+    host_to_device / device_to_host, `nqueens_gpu_chpl.chpl:278-283`).
+    """
+
+    kernel_launches: int = 0
+    host_to_device: int = 0
+    device_to_host: int = 0
+
+
+@dataclass
+class SearchResult:
+    explored_tree: int = 0
+    explored_sol: int = 0
+    best: int | None = None  # final incumbent (PFSP optimum)
+    elapsed: float = 0.0
+    phases: list[PhaseStats] = field(default_factory=list)
+    diagnostics: Diagnostics = field(default_factory=Diagnostics)
+    # multi-device extras (`pfsp_multigpu_chpl.chpl:518-522`)
+    per_worker_tree: list[int] = field(default_factory=list)
+
+    def workload_shares(self) -> list[float]:
+        """Per-worker share of explored nodes (load-balance report,
+        `nqueens_multigpu_chpl.chpl:337`)."""
+        total = sum(self.per_worker_tree)
+        if not total:
+            return []
+        return [100.0 * t / total for t in self.per_worker_tree]

@@ -1,0 +1,50 @@
+"""Sequential search driver — the correctness anchor.
+
+Exact semantics of the reference's sequential tiers
+(`nqueens_chpl.chpl:92-113`, `pfsp_chpl.chpl:191-215`): a single deque,
+pop-back DFS, host decompose. Every other tier must reproduce this tier's
+exploredTree/exploredSol (and optimum, for PFSP with ub=1) — SURVEY.md §4.2.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..pool import SoAPool
+from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
+from .results import PhaseStats, SearchResult
+
+
+def sequential_search(problem: Problem, initial_best: int | None = None) -> SearchResult:
+    best = (
+        initial_best
+        if initial_best is not None
+        else getattr(problem, "initial_ub", INF_BOUND)
+    )
+    pool = SoAPool(problem.node_fields())
+    root = problem.root()
+    pool.push_back(index_batch(root, 0))
+
+    tree = 0
+    sol = 0
+    t0 = time.perf_counter()
+    while True:
+        node = pool.pop_back()
+        if node is None:
+            break
+        res = problem.decompose(node, best)
+        tree += res.tree_inc
+        sol += res.sol_inc
+        best = res.best
+        n = batch_length(res.children)
+        for i in range(n):
+            pool.push_back(index_batch(res.children, i))
+    elapsed = time.perf_counter() - t0
+
+    return SearchResult(
+        explored_tree=tree,
+        explored_sol=sol,
+        best=best,
+        elapsed=elapsed,
+        phases=[PhaseStats(elapsed, tree, sol)],
+    )

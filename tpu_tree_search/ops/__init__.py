@@ -1,0 +1,1 @@
+"""Device kernels (vectorized XLA + Pallas) for batched node evaluation."""

@@ -1,0 +1,247 @@
+"""Batched PFSP lower-bound kernels for TPU (vectorized XLA).
+
+TPU-first reformulation of the reference's per-thread CUDA bound kernels
+(`baselines/pfsp/lib/c_bounds_gpu.cu`, `baselines/pfsp/lib/evaluate.cu:25-91`;
+Chapel: `pfsp_gpu_chpl.chpl:192-254`). Instead of one SIMT thread per
+(parent, child) running scalar loops, each chunk is evaluated as dense
+integer tensor algebra over a ``(B, J)`` lane grid (B parents x J child
+slots), which XLA tiles onto the VPU:
+
+  * Forward branching fixes ``limit2 == jobs`` (`pfsp_chpl.chpl:23-26`), so
+    ``schedule_back`` is always the constant ``min_tails`` table — no tail
+    scans at all.
+  * A child's head schedule is one ``add_forward`` step from its parent's
+    (`c_bound_simple.c:31-38` applied incrementally), so the kernel scans the
+    parent prefix once (O(n) steps of (B, m) vector work) and then does a
+    single unrolled O(m) update per child slot.
+  * The Johnson two-machine recurrence
+        tmp0_t = tmp0_{t-1} + p0_t
+        tmp1_t = max(tmp1_{t-1}, tmp0_t + lag_t) + p1_t          (c_bound_johnson.c:190-209)
+    is a max-plus scan whose closed form is
+        tmp1_n = max( tmp1_0 + sum(p1),  max_t [ tmp0_t + lag_t + suffix_sum(p1)_t ] )
+    i.e. prefix sums + suffix sums + a max reduction — log-depth parallel
+    work instead of a sequential per-thread loop. The data-dependent early
+    exit (`c_bound_johnson.c:231-234`) is dropped: on TPU a masked full
+    reduction is cheaper than divergent control flow, and the host-side
+    pruning decision `bound < best` is provably identical either way (an
+    early-exited value exceeds best iff the full value does).
+
+All arithmetic is int32 (bounds fit comfortably; max makespan < 2^31).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = jnp.int32(-(2**30))
+
+
+def _add_forward_batched(front, pt_job):
+    """One add_forward step over arbitrary leading axes.
+
+    front: (..., m), pt_job: (..., m) processing times of the appended job.
+    Returns the child front. Unrolled over machines (m is small & static).
+    """
+    m = front.shape[-1]
+    cols = [front[..., 0] + pt_job[..., 0]]
+    for j in range(1, m):
+        cols.append(jnp.maximum(cols[-1], front[..., j]) + pt_job[..., j])
+    return jnp.stack(cols, axis=-1)
+
+
+def _machine_bound_from_parts(front, back, remain):
+    """Vectorized `machine_bound_from_parts` (`c_bound_simple.c:126-141`).
+
+    front/remain: (..., m); back: broadcastable (m,). Returns (...,).
+    """
+    m = front.shape[-1]
+    tmp0 = front[..., 0] + remain[..., 0]
+    lb = tmp0 + back[..., 0]
+    for i in range(1, m):
+        tmp1 = jnp.maximum(tmp0, front[..., i] + remain[..., i])
+        lb = jnp.maximum(lb, tmp1 + back[..., i])
+        tmp0 = tmp1
+    return lb
+
+
+def _parent_state(prmu, limit1, ptm_t, min_heads):
+    """Shared per-parent precomputation for a chunk.
+
+    prmu: (B, n) int32; limit1: (B,) int32; ptm_t: (n, m) int32 (transposed
+    processing times); min_heads: (m,).
+
+    Returns (front, remain, ptg, unsched) where
+      front:   (B, m) = schedule_front(prmu, limit1)   (c_bound_simple.c:51-69)
+      remain:  (B, m) = sum_unscheduled(prmu, limit1, n) (c_bound_simple.c:108-124)
+      ptg:     (B, n, m) processing times gathered per position
+      unsched: (B, n) 1.0 where position is free (pos >= limit1 + 1)
+    """
+    B, n = prmu.shape
+    ptg = ptm_t[prmu]  # (B, n, m)
+    pos = jnp.arange(n, dtype=jnp.int32)[None, :]
+    unsched = (pos >= limit1[:, None] + 1).astype(jnp.int32)  # (B, n)
+
+    def body(i, front):
+        newf = _add_forward_batched(front, ptg[:, i, :])
+        take = (i <= limit1)[:, None]
+        return jnp.where(take, newf, front)
+
+    front0 = jnp.zeros((B, ptg.shape[-1]), dtype=jnp.int32)
+    front = jax.lax.fori_loop(0, n, body, front0)
+    # schedule_front(-1) returns min_heads (c_bound_simple.c:58-61); only the
+    # root ever hits this, but keep parity.
+    front = jnp.where((limit1 == -1)[:, None], min_heads[None, :], front)
+    remain = jnp.sum(ptg * unsched[:, :, None], axis=1)  # (B, m)
+    return front, remain, ptg, unsched
+
+
+@partial(jax.jit, static_argnames=())
+def _lb1_chunk(prmu, limit1, ptm_t, min_heads, min_tails):
+    """Bounds of every child of every parent under lb1.
+
+    Child slot (i, k), k >= limit1+1: full `lb1_bound` of the child whose
+    prefix is the parent's plus the job at position k
+    (`pfsp_gpu_chpl.chpl:192-208` / `evaluate.cu:25-49`). Returns (B, n)
+    int32; slots k <= limit1 hold garbage (never read by the host, matching
+    the reference's untouched-slot convention, SURVEY.md Appendix A).
+    """
+    front, remain, ptg, _ = _parent_state(prmu, limit1, ptm_t, min_heads)
+    # Child k appends job prmu[:, k]: one add_forward step per slot.
+    child_front = _add_forward_batched(front[:, None, :], ptg)  # (B, n, m)
+    child_remain = remain[:, None, :] - ptg  # (B, n, m)
+    return _machine_bound_from_parts(child_front, min_tails[None, None, :], child_remain)
+
+
+@partial(jax.jit, static_argnames=())
+def _lb1_d_chunk(prmu, limit1, ptm_t, min_heads, min_tails):
+    """Bounds of every child under lb1_d (`add_front_and_bound`,
+    `c_bound_simple.c:213-244`; device: `pfsp_gpu_chpl.chpl:216-235` /
+    `evaluate.cu:51-71`): O(m) per child from the parent's front/remain,
+    weaker than lb1's full chain but one pass for all children.
+    """
+    front, remain, ptg, _ = _parent_state(prmu, limit1, ptm_t, min_heads)
+    m = front.shape[-1]
+    back = min_tails
+    f = front[:, None, :]  # (B, 1, m)
+    r = remain[:, None, :]
+    lb = f[..., 0] + r[..., 0] + back[0]  # (B, 1) -> broadcasts to (B, n)
+    tmp0 = f[..., 0] + ptg[..., 0]  # (B, n)
+    for i in range(1, m):
+        tmp1 = jnp.maximum(tmp0, f[..., i])
+        lb = jnp.maximum(lb, tmp1 + r[..., i] + back[i])
+        tmp0 = tmp1 + ptg[..., i]
+    return lb
+
+
+@partial(jax.jit, static_argnames=())
+def _lb2_chunk(
+    prmu,
+    limit1,
+    ptm_t,
+    min_heads,
+    min_tails,
+    pairs,
+    lags,
+    johnson_schedules,
+):
+    """Bounds of every child under lb2 (`c_bound_johnson.c:239-254`; device:
+    `pfsp_gpu_chpl.chpl:238-254` / `evaluate.cu:73-91`).
+
+    Per child (i, k) and machine pair (ma0, ma1): the Johnson cmax of the
+    free jobs with lags, via the closed-form max-plus scan (module
+    docstring). A fori_loop over machine pairs carries the running max.
+
+    Shapes: pairs (P, 2), lags/johnson_schedules (P, n).
+    """
+    B, n = prmu.shape
+    front, remain_unused, ptg, unsched = _parent_state(prmu, limit1, ptm_t, min_heads)
+    del remain_unused
+    child_front = _add_forward_batched(front[:, None, :], ptg)  # (B, n, m)
+
+    # Free-job indicator per child, by job id: parent's free jobs minus the
+    # one the child schedules (set_flags, c_bound_johnson.c:180-188, inverted).
+    u_parent = jnp.zeros((B, n), dtype=jnp.int32)
+    bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    u_parent = u_parent.at[bidx, prmu].set(unsched)  # by job id
+    onehot_child = jax.nn.one_hot(prmu, n, dtype=jnp.int32)  # (B, n_slots, n_jobs)
+    u_child = u_parent[:, None, :] * (1 - onehot_child)  # (B, k, job)
+
+    P = pairs.shape[0]
+    ptm = ptm_t.T  # (m, n)
+
+    def pair_body(q, lb):
+        ma0 = pairs[q, 0]
+        ma1 = pairs[q, 1]
+        sched = johnson_schedules[q]  # (n,) job ids in Johnson order
+        lag_o = lags[q][sched]  # (n,) lag per ordered slot
+        p0_o = jnp.take(ptm, ma0, axis=0)[sched]  # (n,)
+        p1_o = jnp.take(ptm, ma1, axis=0)[sched]
+        u_o = jnp.take(u_child, sched, axis=2)  # (B, k, n) ordered free flags
+        mp0 = u_o * p0_o[None, None, :]
+        mp1 = u_o * p1_o[None, None, :]
+        tmp0_0 = jnp.take_along_axis(child_front, jnp.broadcast_to(ma0, (B, n, 1)), axis=2)[..., 0]
+        tmp1_0 = jnp.take_along_axis(child_front, jnp.broadcast_to(ma1, (B, n, 1)), axis=2)[..., 0]
+        t0 = tmp0_0[:, :, None] + jnp.cumsum(mp0, axis=-1)  # running tmp0 at slot t
+        suf1 = (
+            jnp.cumsum(mp1[..., ::-1], axis=-1)[..., ::-1]
+        )  # suffix sum of p1 from t inclusive
+        a = jnp.where(u_o > 0, t0 + lag_o[None, None, :] + suf1, NEG_INF)
+        tmp1 = jnp.maximum(
+            tmp1_0 + jnp.sum(mp1, axis=-1), jnp.max(a, axis=-1)
+        )
+        tmp0 = tmp0_0 + jnp.sum(mp0, axis=-1)
+        pair_lb = jnp.maximum(tmp1 + min_tails[ma1], tmp0 + min_tails[ma0])
+        return jnp.maximum(lb, pair_lb)
+
+    lb0 = jnp.zeros((B, n), dtype=jnp.int32)
+    return jax.lax.fori_loop(0, P, pair_body, lb0)
+
+
+class PFSPDeviceTables:
+    """Instance tables placed on device once per search
+    (`pfsp_gpu_chpl.chpl:362-371`: device-resident lbound1/lbound2 copies).
+    """
+
+    def __init__(self, lb1_data, lb2_data):
+        self.ptm_t = jnp.asarray(np.ascontiguousarray(lb1_data.p_times.T), dtype=jnp.int32)
+        self.min_heads = jnp.asarray(lb1_data.min_heads, dtype=jnp.int32)
+        self.min_tails = jnp.asarray(lb1_data.min_tails, dtype=jnp.int32)
+        self.pairs = jnp.asarray(lb2_data.pairs, dtype=jnp.int32)
+        self.lags = jnp.asarray(lb2_data.lags, dtype=jnp.int32)
+        self.johnson_schedules = jnp.asarray(lb2_data.johnson_schedules, dtype=jnp.int32)
+
+
+def make_evaluator(tables: PFSPDeviceTables, lb: str):
+    """Dispatcher over the three bounds (`pfsp_gpu_chpl.chpl:256-270`).
+
+    Returns ``fn(parents: dict, count, best) -> (B, jobs) int32 bounds``.
+    """
+    if lb == "lb1":
+        def evaluate(parents, count, best):
+            del count, best
+            return _lb1_chunk(
+                parents["prmu"], parents["limit1"], tables.ptm_t,
+                tables.min_heads, tables.min_tails,
+            )
+    elif lb == "lb1_d":
+        def evaluate(parents, count, best):
+            del count, best
+            return _lb1_d_chunk(
+                parents["prmu"], parents["limit1"], tables.ptm_t,
+                tables.min_heads, tables.min_tails,
+            )
+    elif lb == "lb2":
+        def evaluate(parents, count, best):
+            del count, best
+            return _lb2_chunk(
+                parents["prmu"], parents["limit1"], tables.ptm_t,
+                tables.min_heads, tables.min_tails,
+                tables.pairs, tables.lags, tables.johnson_schedules,
+            )
+    else:
+        raise ValueError(f"Unsupported lower bound: {lb!r}")
+    return evaluate

@@ -1,0 +1,5 @@
+"""Host-side work pools (SoA deques)."""
+
+from .pool import SoAPool, ParallelSoAPool
+
+__all__ = ["SoAPool", "ParallelSoAPool"]

@@ -1,0 +1,14 @@
+"""Problem plugins: N-Queens (backtracking) and PFSP (Branch-and-Bound)."""
+
+from .base import INF_BOUND, DecomposeResult, NodeBatch, Problem
+from .nqueens import NQueensProblem
+from .pfsp.problem import PFSPProblem
+
+__all__ = [
+    "INF_BOUND",
+    "DecomposeResult",
+    "NodeBatch",
+    "Problem",
+    "NQueensProblem",
+    "PFSPProblem",
+]

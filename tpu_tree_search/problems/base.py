@@ -1,0 +1,104 @@
+"""Problem protocol — the plugin interface that factors the reference's eight
+copy-pasted programs into one engine (SURVEY.md §1 note, §7.1.1).
+
+A problem supplies:
+  * an SoA node schema (fixed-size fields, device-friendly dtypes),
+  * the root node,
+  * host-side ``decompose`` (evaluate + branch one node) for the sequential
+    tier and the warm-up / drain phases of the offload tiers
+    (`nqueens_chpl.chpl:70-89`, `pfsp_chpl.chpl:88-172`),
+  * a batched device evaluator (children labels/bounds for a chunk of
+    parents) for the offload tiers (`nqueens_gpu_chpl.chpl:97-123`,
+    `pfsp_gpu_chpl.chpl:192-270`),
+  * vectorized host ``generate_children`` consuming device results
+    (`nqueens_gpu_chpl.chpl:126-149`, `pfsp_gpu_chpl.chpl:273-303`).
+
+Node batches are plain dicts ``{field: np.ndarray[batch, ...]}`` (SoA). A
+single node is the same dict with unbatched arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+import numpy as np
+
+# A node batch: field name -> array whose leading axis is the batch.
+NodeBatch = dict[str, np.ndarray]
+
+# Sentinel "no incumbent" upper bound (C uses INT_MAX, `pfsp_c.c`; Chapel
+# max(int)). Kept within int32 so device kernels can carry it.
+INF_BOUND = 2**31 - 1
+
+
+@dataclass
+class DecomposeResult:
+    children: NodeBatch  # surviving children, batch-first SoA
+    tree_inc: int  # nodes pushed (exploredTree increment)
+    sol_inc: int  # leaves visited (exploredSol increment)
+    best: int  # possibly-improved incumbent
+
+
+class Problem:
+    """Interface; see NQueensProblem / PFSPProblem for the two instantiations."""
+
+    name: str = "problem"
+    # Children slots per parent (== branching-factor upper bound): N for
+    # N-Queens, jobs for PFSP. Device result slot [i*width + j] is child j of
+    # parent i (SURVEY.md Appendix A "chunk cycle invariant").
+    child_slots: int
+
+    def node_fields(self) -> Mapping[str, tuple[tuple[int, ...], np.dtype]]:
+        """Field name -> (per-node shape, dtype)."""
+        raise NotImplementedError
+
+    def root(self) -> NodeBatch:
+        """Batch of one: the root node."""
+        raise NotImplementedError
+
+    def decompose(self, node: dict[str, Any], best: int) -> DecomposeResult:
+        """Evaluate + branch one node on host (sequential-tier semantics)."""
+        raise NotImplementedError
+
+    # -- offload tier ------------------------------------------------------
+
+    def make_device_evaluator(self):
+        """Returns a jit-compiled ``fn(parents: dict[str, jnp], count, best)
+        -> results`` evaluating all children of a padded chunk. ``results``
+        has shape (capacity, child_slots).
+        """
+        raise NotImplementedError
+
+    def generate_children(
+        self, parents: NodeBatch, count: int, results: np.ndarray, best: int
+    ) -> DecomposeResult:
+        """Vectorized host-side prune/branch from device results."""
+        raise NotImplementedError
+
+    # -- helpers -----------------------------------------------------------
+
+    def empty_batch(self, capacity: int) -> NodeBatch:
+        return {
+            name: np.zeros((capacity,) + shape, dtype=dtype)
+            for name, (shape, dtype) in self.node_fields().items()
+        }
+
+
+def batch_length(batch: NodeBatch) -> int:
+    for v in batch.values():
+        return v.shape[0]
+    return 0
+
+
+def concat_batches(batches: list[NodeBatch]) -> NodeBatch:
+    keys = batches[0].keys()
+    return {k: np.concatenate([b[k] for b in batches], axis=0) for k in keys}
+
+
+def slice_batch(batch: NodeBatch, lo: int, hi: int) -> NodeBatch:
+    return {k: v[lo:hi] for k, v in batch.items()}
+
+
+def index_batch(batch: NodeBatch, idx) -> NodeBatch:
+    return {k: v[idx] for k, v in batch.items()}

@@ -1,0 +1,146 @@
+"""N-Queens as a backtracking Problem plugin.
+
+Semantics mirror the reference exactly (counting parity is a golden-test
+invariant, SURVEY.md §4.2):
+  * node = (depth, board) where board is a permutation of rows; columns
+    0..depth-1 are placed, the rest are candidates
+    (`lib/nqueens/NQueens_node.chpl:9-31`);
+  * branching swaps board[depth] <=> board[j] for each safe j >= depth
+    (`nqueens_chpl.chpl:70-89`);
+  * a node popped at depth == N counts one solution; children are counted
+    into exploredTree when pushed — including depth-N leaves
+    (`nqueens_chpl.chpl:74-86`);
+  * the safety check runs ``g`` redundant rounds as an artificial workload
+    knob (`nqueens_chpl.chpl:51-67`, `README.md:67-68`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from .base import DecomposeResult, NodeBatch, Problem
+
+
+class NQueensProblem(Problem):
+    name = "nqueens"
+
+    def __init__(self, N: int = 14, g: int = 1):
+        if N <= 0 or g <= 0:
+            raise ValueError("All parameters must be positive integers.")
+        self.N = int(N)
+        self.g = int(g)
+        self.child_slots = self.N
+
+    def node_fields(self):
+        return {
+            "depth": ((), np.dtype(np.int32)),
+            "board": ((self.N,), np.dtype(np.uint8)),
+        }
+
+    def root(self) -> NodeBatch:
+        return {
+            "depth": np.zeros((1,), dtype=np.int32),
+            "board": np.arange(self.N, dtype=np.uint8)[None, :],
+        }
+
+    # -- host path ---------------------------------------------------------
+
+    def is_safe(self, board: np.ndarray, queen_num: int, row_pos: int) -> bool:
+        """Diagonal-safety check (`nqueens_chpl.chpl:51-67`). The ``g`` loop
+        only repeats the same comparisons (workload knob), so one round
+        decides the label.
+        """
+        if queen_num == 0:
+            return True
+        i = np.arange(queen_num)
+        other = board[:queen_num].astype(np.int64)
+        d = queen_num - i
+        return bool(np.all((other != row_pos - d) & (other != row_pos + d)))
+
+    def decompose(self, node: dict, best: int) -> DecomposeResult:
+        depth = int(node["depth"])
+        board = node["board"]
+        N = self.N
+        if depth == N:
+            return DecomposeResult(self.empty_batch(0), 0, 1, best)
+        kept = []
+        for j in range(depth, N):
+            if self.is_safe(board, depth, int(board[j])):
+                child = board.copy()
+                child[depth], child[j] = child[j], child[depth]
+                kept.append(child)
+        children = {
+            "depth": np.full(len(kept), depth + 1, dtype=np.int32),
+            "board": (
+                np.stack(kept) if kept else np.zeros((0, N), dtype=np.uint8)
+            ),
+        }
+        return DecomposeResult(children, len(kept), 0, best)
+
+    # -- device path -------------------------------------------------------
+
+    def make_device_evaluator(self):
+        import jax
+        import jax.numpy as jnp
+
+        N, g = self.N, self.g
+
+        @partial(jax.jit, static_argnums=())
+        def evaluate(parents, count, best):
+            """Batched safety labels, one slot per (parent, candidate column)
+            (`nqueens_gpu_chpl.chpl:97-123`). labels[i, k] == 1 iff swapping
+            column k into position depth_i is safe; slots with k < depth are
+            untouched garbage in the reference — we emit 0 there, and
+            generate_children only reads k >= depth either way.
+            """
+            del count, best
+            board = parents["board"].astype(jnp.int32)  # (B, N)
+            depth = parents["depth"].astype(jnp.int32)  # (B,)
+            qk = board[:, None, :]  # candidate row for slot k: (B, 1, N)
+            bi = board[:, :, None]  # placed queen rows:        (B, N, 1)
+            i = jnp.arange(N, dtype=jnp.int32)
+            d = depth[:, None] - i[None, :]  # (B, N): depth - i
+            placed = i[None, :] < depth[:, None]  # (B, N) mask over i
+            clash = (bi == qk - d[:, :, None]) | (bi == qk + d[:, :, None])
+            safe = ~jnp.any(clash & placed[:, :, None], axis=1)  # (B, N)
+            if g > 1:
+                # Honor the g workload knob with a real loop op so XLA cannot
+                # CSE the redundant rechecks away (the reference repeats the
+                # comparisons g times, `nqueens_gpu_chpl.chpl:115-118`).
+                def recheck(_, s):
+                    c = (bi == qk - d[:, :, None]) | (bi == qk + d[:, :, None])
+                    return s & ~jnp.any(c & placed[:, :, None], axis=1)
+
+                safe = jax.lax.fori_loop(0, g - 1, recheck, safe)
+            k = jnp.arange(N, dtype=jnp.int32)[None, :]
+            valid = k >= depth[:, None]
+            return (safe & valid).astype(jnp.uint8)
+
+        return evaluate
+
+    def generate_children(
+        self, parents: NodeBatch, count: int, results: np.ndarray, best: int
+    ) -> DecomposeResult:
+        """Vectorized equivalent of `nqueens_gpu_chpl.chpl:126-149`."""
+        N = self.N
+        depth = parents["depth"][:count].astype(np.int64)
+        board = parents["board"][:count]
+        labels = np.asarray(results[:count]).astype(bool)  # (count, N)
+        k = np.arange(N)[None, :]
+        is_parent_leaf = depth == N
+        sol_inc = int(is_parent_leaf.sum())
+        mask = labels & (k >= depth[:, None]) & ~is_parent_leaf[:, None]
+        pi, kj = np.nonzero(mask)
+        children_board = board[pi].copy()
+        rows = np.arange(pi.size)
+        di = depth[pi].astype(np.int64)
+        tmp = children_board[rows, di]
+        children_board[rows, di] = children_board[rows, kj]
+        children_board[rows, kj] = tmp
+        children = {
+            "depth": (depth[pi] + 1).astype(np.int32),
+            "board": children_board,
+        }
+        return DecomposeResult(children, int(pi.size), sol_inc, best)
