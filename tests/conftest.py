@@ -4,12 +4,16 @@ Tests run on a virtual 8-device CPU platform so the multi-device and
 multi-host tiers are exercised without TPU hardware (SURVEY.md §4's
 fake-multi-host strategy; cf. the reference's oversubscribed-locale smoke
 testing via CHPL_COMM_SUBSTRATE=udp, `g5k_dist_multigpu_nvidia.sh:33`).
-Environment must be set before jax is first imported.
+Environment must be set before jax is first imported: the image's
+sitecustomize force-registers the TPU backend unless PALLAS_AXON_POOL_IPS is
+cleared, and JAX_PLATFORMS=axon arrives from the ambient environment, so both
+must be overridden (not defaulted).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable TPU plugin registration
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,88 @@
+"""Device-tier parity tests (SURVEY.md §4 implication (b)): the chunked
+single-device engine must reproduce the sequential engine's counts exactly
+when the incumbent is fixed (N-Queens never prunes; PFSP ub=1 never improves
+the incumbent), and the same optimum in all cases.
+
+Runs on the CPU backend (conftest pins JAX_PLATFORMS=cpu) — the engine is
+backend-agnostic; the driver exercises it on real TPU.
+"""
+
+import pytest
+
+from tpu_tree_search.engine import sequential_search
+from tpu_tree_search.engine.device import bucket_size, device_search
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard as T
+
+
+def test_bucket_size():
+    # Lower clamp: everything below m folds into the next_pow2(m) bucket.
+    assert bucket_size(1, 25, 50000) == 32
+    assert bucket_size(25, 25, 50000) == 32
+    assert bucket_size(33, 25, 50000) == 64
+    assert bucket_size(50000, 25, 50000) == 50000
+    assert bucket_size(70000, 25, 50000) == 50000
+
+
+def test_pad_chunk_pads_to_bucket():
+    from tpu_tree_search.engine.device import pad_chunk
+    import numpy as np
+
+    snap = {"x": np.arange(10, dtype=np.int32), "y": np.ones((10, 3), np.int8)}
+    padded = pad_chunk(snap, 10, 16)
+    assert padded["x"].shape == (16,)
+    assert padded["y"].shape == (16, 3)
+    assert (padded["x"][10:] == snap["x"][0]).all()
+    exact = pad_chunk(snap, 10, 10)
+    assert exact["x"].shape == (10,)
+
+
+@pytest.mark.parametrize("n", [8, 10])
+@pytest.mark.parametrize("overlap", [False, True])
+def test_nqueens_device_matches_sequential(n, overlap):
+    seq = sequential_search(NQueensProblem(N=n))
+    dev = device_search(NQueensProblem(N=n), m=25, M=1024, overlap=overlap)
+    assert dev.explored_sol == seq.explored_sol
+    assert dev.explored_tree == seq.explored_tree
+
+
+def test_nqueens_device_g_knob():
+    dev1 = device_search(NQueensProblem(N=8, g=1), m=25, M=512)
+    dev3 = device_search(NQueensProblem(N=8, g=3), m=25, M=512)
+    assert (dev1.explored_tree, dev1.explored_sol) == (
+        dev3.explored_tree,
+        dev3.explored_sol,
+    )
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb1_d", "lb2"])
+def test_pfsp_device_finds_optimum_ub0(lb):
+    ptm = T.reduced_instance(14, jobs=7, machines=5)
+    seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm))
+    dev = device_search(PFSPProblem(lb=lb, ub=0, p_times=ptm), m=10, M=256)
+    assert dev.best == seq.best
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb1_d", "lb2"])
+def test_pfsp_device_matches_sequential_with_fixed_incumbent(lb):
+    """With the incumbent seeded at the optimum it never improves, so the
+    pruned tree is order-independent and counts must match exactly (the
+    reference's ub=1 determinism invariant, SURVEY.md §4.2)."""
+    ptm = T.reduced_instance(14, jobs=8, machines=5)
+    opt = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm)).best
+    seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm), initial_best=opt)
+    dev = device_search(
+        PFSPProblem(lb=lb, ub=0, p_times=ptm), m=10, M=128, initial_best=opt
+    )
+    assert dev.best == seq.best == opt
+    assert dev.explored_tree == seq.explored_tree
+    assert dev.explored_sol == seq.explored_sol
+
+
+def test_pfsp_device_diagnostics_counted():
+    ptm = T.reduced_instance(14, jobs=7, machines=5)
+    dev = device_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm), m=10, M=256)
+    d = dev.diagnostics
+    assert d.kernel_launches > 0
+    assert d.host_to_device == d.kernel_launches
+    assert d.device_to_host == d.kernel_launches

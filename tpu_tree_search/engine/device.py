@@ -1,0 +1,227 @@
+"""Single-device chunked-offload search driver.
+
+TPU-first re-design of the reference's 3-step single-GPU drivers
+(`nqueens_gpu_chpl.chpl:152-245`, `pfsp_gpu_chpl.chpl:306-452`):
+
+  step 1  CPU BFS warm-up: pop-front + host decompose until the pool holds at
+          least ``warmup_target`` nodes (`nqueens_gpu_chpl.chpl:169-175`);
+  step 2  hot loop: pop a back chunk of ``m..M`` parents, evaluate all
+          children on device, prune/branch on host, push survivors
+          (`nqueens_gpu_chpl.chpl:197-215`);
+  step 3  CPU DFS drain of the remainder (`nqueens_gpu_chpl.chpl:230-236`).
+
+Differences from the reference, driven by the XLA compilation model
+(SURVEY.md §7.3):
+
+  * **Shape bucketing.** `popBackBulk` yields a variable chunk size; XLA
+    wants static shapes. Chunks are padded to power-of-two buckets so at most
+    ~log2(M/m) compilations ever happen; padded slots carry a cloned valid
+    node and their results are sliced away before the host prune. (The
+    reference always allocates full-M device buffers and launches
+    size-dependent grids, `pfsp_gpu_chpl.chpl:356-360` — on TPU the bucket
+    pad is the analogue.)
+  * **Async dispatch overlap.** JAX dispatch is asynchronous: the driver
+    pops and dispatches chunk i+1 *before* consuming chunk i's device
+    results, overlapping device compute with the host-side prune/branch of
+    the previous chunk — the reference's loop is fully synchronous
+    (`pfsp_gpu_chpl.chpl:373-396`). With a fixed incumbent (ub=1, or
+    N-Queens which never prunes) the explored tree is provably identical;
+    with an improving incumbent it is a valid B&B relaxation (same optimum,
+    possibly different node count — same property the reference's multi-GPU
+    tier already has, SURVEY.md §2.4.4).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..pool import SoAPool
+from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
+from .results import Diagnostics, PhaseStats, SearchResult
+
+
+def bucket_size(count: int, m: int, M: int) -> int:
+    """Smallest power-of-two bucket >= count, clamped to [next_pow2(m), M].
+
+    The lower clamp matters for the tail of the search: step 2 never pops
+    fewer than m nodes, but warm-up targets and tests can push small counts —
+    folding them all into the m-bucket keeps the number of compiled shapes at
+    ~log2(M/m) + 1.
+    """
+    lo = 1
+    while lo < m:
+        lo *= 2
+    b = lo
+    while b < count:
+        b *= 2
+    return min(b, M)
+
+
+def pad_chunk(parents: dict, count: int, bucket: int) -> dict:
+    """Pad a popped chunk up to its bucket by cloning node 0 into the tail.
+
+    A cloned valid node (not zeros) keeps device arithmetic in-range for any
+    problem; its result slots are ignored (`generate_children` reads only
+    ``[:count]``, matching the reference's untouched-slot convention,
+    SURVEY.md Appendix A).
+    """
+    if count >= bucket:
+        return {name: arr[:bucket] for name, arr in parents.items()}
+    out = {}
+    for name, arr in parents.items():
+        buf = np.empty((bucket,) + arr.shape[1:], dtype=arr.dtype)
+        buf[:count] = arr[:count]
+        buf[count:] = arr[0]
+        out[name] = buf
+    return out
+
+
+class DeviceOffloader:
+    """Owns the device-side evaluator + transfer bookkeeping for one device.
+
+    Counts launches/copies like Chapel's GpuDiagnostics
+    (`pfsp_gpu_chpl.chpl:454-466`).
+    """
+
+    def __init__(self, problem: Problem, device=None):
+        import jax
+
+        self.problem = problem
+        self.device = device if device is not None else jax.devices()[0]
+        self._evaluate = problem.make_device_evaluator()
+        self.diagnostics = Diagnostics()
+
+    def dispatch(self, parents_np: dict, count: int, bucket: int, best: int):
+        """H2D + async kernel dispatch; returns an unmaterialized device result."""
+        import jax
+
+        padded = pad_chunk(parents_np, count, bucket)
+        parents_dev = {
+            k: jax.device_put(v, self.device) for k, v in padded.items()
+        }
+        self.diagnostics.host_to_device += 1
+        result = self._evaluate(parents_dev, count, best)
+        self.diagnostics.kernel_launches += 1
+        return result
+
+    def collect(self, result) -> np.ndarray:
+        """D2H (blocks until the device result is ready)."""
+        out = np.asarray(result)
+        self.diagnostics.device_to_host += 1
+        return out
+
+
+def warmup(problem: Problem, pool: SoAPool, best: int, target: int):
+    """Step 1: breadth-first host expansion until ``pool.size >= target``
+    (`nqueens_gpu_chpl.chpl:169-175`). Pops from the *front* so the leftover
+    pool is shallow-first (SURVEY.md Appendix A warm-up note).
+    Returns (tree_inc, sol_inc, best).
+    """
+    tree = 0
+    sol = 0
+    while pool.size > 0 and pool.size < target:
+        node = pool.pop_front()
+        res = problem.decompose(node, best)
+        tree += res.tree_inc
+        sol += res.sol_inc
+        best = res.best
+        pool.push_back_bulk(res.children)
+    return tree, sol, best
+
+
+def drain(problem: Problem, pool: SoAPool, best: int):
+    """Step 3: host DFS of whatever is left (`nqueens_gpu_chpl.chpl:230-236`)."""
+    tree = 0
+    sol = 0
+    while True:
+        node = pool.pop_back()
+        if node is None:
+            break
+        res = problem.decompose(node, best)
+        tree += res.tree_inc
+        sol += res.sol_inc
+        best = res.best
+        n = batch_length(res.children)
+        for i in range(n):
+            pool.push_back(index_batch(res.children, i))
+    return tree, sol, best
+
+
+def device_search(
+    problem: Problem,
+    m: int = 25,
+    M: int = 50000,
+    device=None,
+    initial_best: int | None = None,
+    overlap: bool = True,
+    warmup_target: int | None = None,
+) -> SearchResult:
+    best = (
+        initial_best
+        if initial_best is not None
+        else getattr(problem, "initial_ub", INF_BOUND)
+    )
+    pool = SoAPool(problem.node_fields())
+    pool.push_back(index_batch(problem.root(), 0))
+    off = DeviceOffloader(problem, device)
+
+    phases: list[PhaseStats] = []
+    t0 = time.perf_counter()
+
+    # -- step 1: warm-up ---------------------------------------------------
+    tree1, sol1, best = warmup(problem, pool, best, warmup_target or m)
+    t1 = time.perf_counter()
+    phases.append(PhaseStats(t1 - t0, tree1, sol1))
+
+    # -- step 2: chunked offload loop --------------------------------------
+    tree2 = 0
+    sol2 = 0
+    chunk_buf = problem.empty_batch(M)
+    pending = None  # (parents_np_snapshot, count, device_result)
+
+    def consume(p):
+        nonlocal tree2, sol2, best
+        parents_np, count, dev_result = p
+        results = off.collect(dev_result)
+        res = problem.generate_children(parents_np, count, results, best)
+        tree2 += res.tree_inc
+        sol2 += res.sol_inc
+        best = res.best
+        pool.push_back_bulk(res.children)
+
+    while True:
+        count = pool.pop_back_bulk(m, M, chunk_buf)
+        if count == 0:
+            if pending is not None:
+                consume(pending)
+                pending = None
+                continue  # children may refill the pool past m
+            break
+        bucket = bucket_size(count, m, M)
+        snapshot = {k: v[:count].copy() for k, v in chunk_buf.items()}
+        dev_result = off.dispatch(snapshot, count, bucket, best)
+        if overlap and pending is not None:
+            consume(pending)
+            pending = (snapshot, count, dev_result)
+        elif overlap:
+            pending = (snapshot, count, dev_result)
+        else:
+            consume((snapshot, count, dev_result))
+    t2 = time.perf_counter()
+    phases.append(PhaseStats(t2 - t1, tree2, sol2))
+
+    # -- step 3: drain ------------------------------------------------------
+    tree3, sol3, best = drain(problem, pool, best)
+    t3 = time.perf_counter()
+    phases.append(PhaseStats(t3 - t2, tree3, sol3))
+
+    return SearchResult(
+        explored_tree=tree1 + tree2 + tree3,
+        explored_sol=sol1 + sol2 + sol3,
+        best=best,
+        elapsed=t3 - t0,
+        phases=phases,
+        diagnostics=off.diagnostics,
+    )

@@ -1,0 +1,47 @@
+"""Batched N-Queens safety kernel (vectorized XLA).
+
+TPU-first reformulation of the reference's per-thread SIMT kernel
+(`nqueens_gpu_chpl.chpl:97-123`, `baselines/nqueens/nqueens_gpu_cuda.cu:137-164`):
+one (B, N, N) clash tensor — (parent, placed queen i, candidate slot k) —
+reduced over i, instead of one scalar thread per (parent, k). All int32 lane
+work; XLA tiles it onto the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_core(N: int, g: int = 1):
+    """Returns ``fn(board: (B, N) uint8/int32, depth: (B,) int32) -> (B, N)
+    uint8`` labels; labels[i, k] == 1 iff swapping slot k into position
+    depth_i keeps all diagonals safe. Slots k < depth are 0 (the reference
+    leaves them as garbage and never reads them; emitting 0 is strictly
+    safer, SURVEY.md Appendix A).
+    """
+
+    def core(board, depth):
+        board = board.astype(jnp.int32)  # (B, N)
+        depth = depth.astype(jnp.int32)  # (B,)
+        qk = board[:, None, :]  # candidate row for slot k: (B, 1, N)
+        bi = board[:, :, None]  # placed queen rows:        (B, N, 1)
+        i = jnp.arange(N, dtype=jnp.int32)
+        d = depth[:, None] - i[None, :]  # (B, N): depth - i
+        placed = i[None, :] < depth[:, None]  # (B, N) mask over i
+        clash = (bi == qk - d[:, :, None]) | (bi == qk + d[:, :, None])
+        safe = ~jnp.any(clash & placed[:, :, None], axis=1)  # (B, N)
+        if g > 1:
+            # Honor the g workload knob with a real loop op so XLA cannot
+            # CSE the redundant rechecks away (the reference repeats the
+            # comparisons g times, `nqueens_gpu_chpl.chpl:115-118`).
+            def recheck(_, s):
+                c = (bi == qk - d[:, :, None]) | (bi == qk + d[:, :, None])
+                return s & ~jnp.any(c & placed[:, :, None], axis=1)
+
+            safe = jax.lax.fori_loop(0, g - 1, recheck, safe)
+        k = jnp.arange(N, dtype=jnp.int32)[None, :]
+        valid = k >= depth[:, None]
+        return (safe & valid).astype(jnp.uint8)
+
+    return core

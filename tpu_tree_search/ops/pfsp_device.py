@@ -90,7 +90,9 @@ def _parent_state(prmu, limit1, ptm_t, min_heads):
         take = (i <= limit1)[:, None]
         return jnp.where(take, newf, front)
 
-    front0 = jnp.zeros((B, ptg.shape[-1]), dtype=jnp.int32)
+    # Derive the zero init from ptg (not jnp.zeros) so the carry inherits
+    # ptg's varying-manual-axes type under shard_map (scan-vma rule).
+    front0 = ptg[:, 0, :] * 0
     front = jax.lax.fori_loop(0, n, body, front0)
     # schedule_front(-1) returns min_heads (c_bound_simple.c:58-61); only the
     # root ever hits this, but keep parity.
@@ -197,7 +199,9 @@ def _lb2_chunk(
         pair_lb = jnp.maximum(tmp1 + min_tails[ma1], tmp0 + min_tails[ma0])
         return jnp.maximum(lb, pair_lb)
 
-    lb0 = jnp.zeros((B, n), dtype=jnp.int32)
+    # Zero init derived from varying operands (not jnp.zeros) so the carry
+    # type matches under shard_map along both dp (prmu) and mp (lags) axes.
+    lb0 = prmu * 0 + 0 * jnp.min(lags).astype(jnp.int32)
     return jax.lax.fori_loop(0, P, pair_body, lb0)
 
 

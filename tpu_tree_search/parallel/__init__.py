@@ -1,0 +1,9 @@
+"""Parallel runtimes: multi-device (threads + work stealing + termination),
+mesh-SPMD chunk evaluation (jax.sharding + collectives), and the multi-host
+distributed tier (jax.distributed).
+
+Replaces the reference's L4 layer — the inlined partitioning / work-stealing /
+termination scaffolding of the multi-GPU and distributed mains
+(`nqueens_multigpu_chpl.chpl:199-320`, `pfsp_dist_multigpu_chpl.chpl:292-377`)
+— with reusable components (SURVEY.md §2.4).
+"""

@@ -1,0 +1,208 @@
+"""SPMD chunk evaluation over a TPU device mesh.
+
+The reference parallelizes across GPUs with one host task per device and a
+private pool each (`pfsp_multigpu_chpl.chpl:375-435`). On TPU there is a
+second, more idiomatic axis: a single jitted step sharded over the whole
+mesh, where XLA inserts the collectives (scaling-book recipe). This module
+provides that step:
+
+  * ``dp`` axis: the chunk's parent batch is sharded across devices — the
+    direct analogue of the reference's one-GPU-per-chunk-slice, but with one
+    dispatch for all chips and ICI (not host) moving the data.
+  * ``mp`` axis (PFSP lb2 only): the Johnson machine-pair loop — the O(m²)
+    table axis (`c_bound_johnson.c:48-92`) — is sharded, each device reducing
+    its pair subset, combined with a ``jax.lax.pmax``. This is the
+    model-parallel analogue the SIMT design has no equivalent of.
+  * incumbent all-reduce: leaf makespans are min-reduced across the mesh
+    inside the same step (``jax.lax.pmin``) — the mid-search UB broadcast the
+    reference lacks entirely (SURVEY.md §2.4.4: reconciliation only at
+    terminal reduction; BASELINE north star names this the planned
+    improvement).
+
+The step is shape-static and donates nothing host-side: the multi-device
+engine calls it once per chunk with the batch padded to a bucket.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..problems.base import INF_BOUND
+
+
+def make_mesh(n_devices: int | None = None, mp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, mp) mesh over the first ``n_devices`` local devices.
+
+    ``mp`` > 1 carves off a machine-pair axis for lb2; everything else uses
+    pure data parallelism (mp=1).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = np.asarray(devices[:n_devices])
+    if n_devices % mp != 0:
+        raise ValueError(f"n_devices={n_devices} not divisible by mp={mp}")
+    return Mesh(devices.reshape(n_devices // mp, mp), ("dp", "mp"))
+
+
+def _pad_len(n: int, k: int) -> int:
+    return (n + k - 1) // k * k
+
+
+class MeshEvaluator:
+    """Sharded chunk evaluator for one problem over one mesh.
+
+    ``__call__(parents, count, best) -> (results, new_best)`` where parents
+    is a host-side dict batch (padded to a multiple of dp), results is a
+    host-materializable array of per-child labels/bounds, and new_best folds
+    the chunk's leaf improvements via an in-step mesh-wide min.
+    """
+
+    def __init__(self, problem, mesh: Mesh):
+        self.problem = problem
+        self.mesh = mesh
+        self.dp = mesh.shape["dp"]
+        self.mp = mesh.shape["mp"]
+        self._step = self._build(problem, mesh)
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self, problem, mesh):
+        if problem.name == "pfsp":
+            return self._build_pfsp(problem, mesh)
+        return self._build_nqueens(problem, mesh)
+
+    def _build_nqueens(self, problem, mesh):
+        from ..ops import nqueens_device
+
+        core = nqueens_device.make_core(problem.N, problem.g)
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=({"depth": P("dp"), "board": P("dp", None)},),
+            out_specs=P("dp", None),
+        )
+        def step(parents):
+            # mp axis unused for N-Queens: labels are replicated along it.
+            # No incumbent exists (backtracking never prunes), so the step
+            # returns labels only — no collective needed.
+            return core(parents["board"], parents["depth"])
+
+        jitted = jax.jit(step)
+
+        def run(parents, count, best):
+            del count, best
+            return jitted(parents), INF_BOUND
+
+        return jitted, run
+
+    def _build_pfsp(self, problem, mesh):
+        from ..ops import pfsp_device
+
+        tables = pfsp_device.PFSPDeviceTables(problem.lb1_data, problem.lb2_data)
+        jobs = problem.jobs
+        lb = problem.lb
+        # Pad the pair tables to a multiple of mp with copies of pair 0 —
+        # a duplicated pair only re-maxes the same value (max is idempotent).
+        pairs = np.asarray(tables.pairs)
+        lags = np.asarray(tables.lags)
+        scheds = np.asarray(tables.johnson_schedules)
+        if lb == "lb2":
+            P_pairs = pairs.shape[0]
+            P_padded = _pad_len(P_pairs, self.mp)
+            if P_padded != P_pairs:
+                reps = P_padded - P_pairs
+                pairs = np.concatenate([pairs, np.repeat(pairs[:1], reps, 0)])
+                lags = np.concatenate([lags, np.repeat(lags[:1], reps, 0)])
+                scheds = np.concatenate([scheds, np.repeat(scheds[:1], reps, 0)])
+
+        node_spec = {"depth": P("dp"), "limit1": P("dp"), "prmu": P("dp", None)}
+
+        if lb == "lb2":
+            in_specs = (
+                node_spec,
+                P(),  # best
+                P(None, None),  # ptm_t
+                P(None),  # min_heads
+                P(None),  # min_tails
+                P("mp", None),  # pairs
+                P("mp", None),  # lags
+                P("mp", None),  # johnson_schedules
+            )
+
+            @partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+                     out_specs=(P("dp", None), P()))
+            def step(parents, best, ptm_t, min_heads, min_tails, prs, lgs, sch):
+                local = pfsp_device._lb2_chunk(
+                    parents["prmu"], parents["limit1"], ptm_t,
+                    min_heads, min_tails, prs, lgs, sch,
+                )
+                bounds = jax.lax.pmax(local, "mp")  # combine pair subsets
+                new_best = _fold_leaf_best(parents, bounds, best, jobs)
+                return bounds, new_best
+
+            args = (
+                jnp.asarray(tables.ptm_t), jnp.asarray(tables.min_heads),
+                jnp.asarray(tables.min_tails), jnp.asarray(pairs),
+                jnp.asarray(lags), jnp.asarray(scheds),
+            )
+        else:
+            chunk = (
+                pfsp_device._lb1_chunk if lb == "lb1" else pfsp_device._lb1_d_chunk
+            )
+            in_specs = (node_spec, P(), P(None, None), P(None), P(None))
+
+            @partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+                     out_specs=(P("dp", None), P()))
+            def step(parents, best, ptm_t, min_heads, min_tails):
+                bounds = chunk(
+                    parents["prmu"], parents["limit1"], ptm_t, min_heads, min_tails
+                )
+                new_best = _fold_leaf_best(parents, bounds, best, jobs)
+                return bounds, new_best
+
+            args = (
+                jnp.asarray(tables.ptm_t), jnp.asarray(tables.min_heads),
+                jnp.asarray(tables.min_tails),
+            )
+
+        jitted = jax.jit(step)
+
+        def run(parents, count, best):
+            del count
+            bounds, new_best = jitted(parents, jnp.int32(best), *args)
+            return bounds, int(new_best)
+
+        return jitted, run
+
+    # -- call --------------------------------------------------------------
+
+    def pad_to_mesh(self, count: int) -> int:
+        return _pad_len(count, self.dp)
+
+    def __call__(self, parents, count, best):
+        _, run = self._step
+        return run(parents, count, best)
+
+
+def _fold_leaf_best(parents, bounds, best, jobs):
+    """Mesh-wide incumbent fold: min over this shard's leaf-child makespans,
+    then pmin across dp (the in-step UB all-reduce; mp shards share identical
+    leaf values after pmax so pmin over dp suffices — pmin over mp would also
+    be a no-op).
+    """
+    depth = parents["depth"]
+    limit1 = parents["limit1"]
+    j = jnp.arange(bounds.shape[1], dtype=jnp.int32)[None, :]
+    open_slot = j >= (limit1[:, None] + 1)
+    is_leaf = jnp.logical_and(depth[:, None] + 1 == jobs, open_slot)
+    leaf_min = jnp.min(jnp.where(is_leaf, bounds, jnp.int32(INF_BOUND)))
+    new_best = jnp.minimum(jnp.int32(best), leaf_min)
+    return jax.lax.pmin(new_best, "dp")

@@ -83,40 +83,17 @@ class NQueensProblem(Problem):
 
     def make_device_evaluator(self):
         import jax
-        import jax.numpy as jnp
 
-        N, g = self.N, self.g
+        from ..ops import nqueens_device
+
+        core = nqueens_device.make_core(self.N, self.g)
 
         @partial(jax.jit, static_argnums=())
         def evaluate(parents, count, best):
             """Batched safety labels, one slot per (parent, candidate column)
-            (`nqueens_gpu_chpl.chpl:97-123`). labels[i, k] == 1 iff swapping
-            column k into position depth_i is safe; slots with k < depth are
-            untouched garbage in the reference — we emit 0 there, and
-            generate_children only reads k >= depth either way.
-            """
+            (`nqueens_gpu_chpl.chpl:97-123`)."""
             del count, best
-            board = parents["board"].astype(jnp.int32)  # (B, N)
-            depth = parents["depth"].astype(jnp.int32)  # (B,)
-            qk = board[:, None, :]  # candidate row for slot k: (B, 1, N)
-            bi = board[:, :, None]  # placed queen rows:        (B, N, 1)
-            i = jnp.arange(N, dtype=jnp.int32)
-            d = depth[:, None] - i[None, :]  # (B, N): depth - i
-            placed = i[None, :] < depth[:, None]  # (B, N) mask over i
-            clash = (bi == qk - d[:, :, None]) | (bi == qk + d[:, :, None])
-            safe = ~jnp.any(clash & placed[:, :, None], axis=1)  # (B, N)
-            if g > 1:
-                # Honor the g workload knob with a real loop op so XLA cannot
-                # CSE the redundant rechecks away (the reference repeats the
-                # comparisons g times, `nqueens_gpu_chpl.chpl:115-118`).
-                def recheck(_, s):
-                    c = (bi == qk - d[:, :, None]) | (bi == qk + d[:, :, None])
-                    return s & ~jnp.any(c & placed[:, :, None], axis=1)
-
-                safe = jax.lax.fori_loop(0, g - 1, recheck, safe)
-            k = jnp.arange(N, dtype=jnp.int32)[None, :]
-            valid = k >= depth[:, None]
-            return (safe & valid).astype(jnp.uint8)
+            return core(parents["board"], parents["depth"])
 
         return evaluate
 
