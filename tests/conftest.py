@@ -4,10 +4,11 @@ Tests run on a virtual 8-device CPU platform so the multi-device and
 multi-host tiers are exercised without TPU hardware (SURVEY.md §4's
 fake-multi-host strategy; cf. the reference's oversubscribed-locale smoke
 testing via CHPL_COMM_SUBSTRATE=udp, `g5k_dist_multigpu_nvidia.sh:33`).
-Environment must be set before jax is first imported: the image's
-sitecustomize force-registers the TPU backend unless PALLAS_AXON_POOL_IPS is
-cleared, and JAX_PLATFORMS=axon arrives from the ambient environment, so both
-must be overridden (not defaulted).
+
+The image's sitecustomize registers the TPU backend at interpreter startup
+and pins the platform through jax's config (not just the environment), so
+overriding the environment here is not enough — the config must be updated
+too, before any backend initializes.
 """
 
 import os
@@ -19,3 +20,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

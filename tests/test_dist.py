@@ -1,0 +1,66 @@
+"""Distributed-tier tests with virtual hosts (threads) on the 8-device CPU
+platform — the fake multi-host runtime of SURVEY.md §4 implication (d)."""
+
+import pytest
+
+from tpu_tree_search.engine import sequential_search
+from tpu_tree_search.parallel.dist import ThreadCollectives, dist_search
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard as T
+
+
+def test_thread_collectives():
+    import threading
+
+    coll = ThreadCollectives(3)
+    out = {}
+
+    def run(h):
+        c = coll.bind(h)
+        out[h] = (c.allreduce_sum(h + 1), c.allreduce_min(h), c.allreduce_max(h))
+
+    ts = [threading.Thread(target=run, args=(h,)) for h in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert out[0] == (6, 0, 2)
+    assert out[0] == out[1] == out[2]
+
+
+@pytest.mark.parametrize("H,D", [(2, 2), (4, 1)])
+def test_nqueens_dist_matches_sequential(H, D):
+    seq = sequential_search(NQueensProblem(N=9))
+    ds = dist_search(NQueensProblem(N=9), m=5, M=128, D=D, num_hosts=H)
+    assert ds.explored_sol == seq.explored_sol
+    assert ds.explored_tree == seq.explored_tree
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb2"])
+def test_pfsp_dist_finds_optimum(lb):
+    ptm = T.reduced_instance(14, jobs=7, machines=5)
+    seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm))
+    ds = dist_search(
+        PFSPProblem(lb=lb, ub=0, p_times=ptm), m=5, M=64, D=2, num_hosts=2
+    )
+    assert ds.best == seq.best
+
+
+def test_pfsp_dist_fixed_incumbent_parity():
+    ptm = T.reduced_instance(14, jobs=8, machines=5)
+    opt = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm)).best
+    seq = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm), initial_best=opt)
+    ds = dist_search(
+        PFSPProblem(lb="lb1", ub=0, p_times=ptm),
+        m=5, M=64, D=2, num_hosts=2, initial_best=opt,
+    )
+    assert ds.best == opt
+    assert ds.explored_tree == seq.explored_tree
+    assert ds.explored_sol == seq.explored_sol
+
+
+def test_dist_single_host_degenerate():
+    seq = sequential_search(NQueensProblem(N=8))
+    ds = dist_search(NQueensProblem(N=8), m=5, M=128, num_hosts=1)
+    assert ds.explored_sol == seq.explored_sol
+    assert ds.explored_tree == seq.explored_tree

@@ -1,0 +1,75 @@
+"""Mesh-SPMD evaluator tests on the virtual 8-device CPU mesh: sharded
+bounds must match the unsharded evaluators bit-exactly, the lb2 machine-pair
+(mp) sharding must be transparent, and the in-step incumbent fold must
+respect the valid-row count."""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.parallel import mesh as M
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard as T
+
+
+def _random_parents(jobs, B, depth, limit1, seed=0):
+    rng = np.random.default_rng(seed)
+    prmu = np.tile(np.arange(jobs, dtype=np.int32), (B, 1))
+    for i in range(B):
+        rng.shuffle(prmu[i])
+    return {
+        "depth": np.full((B,), depth, dtype=np.int32),
+        "limit1": np.full((B,), limit1, dtype=np.int32),
+        "prmu": prmu,
+    }
+
+
+def test_nqueens_mesh_matches_unsharded():
+    prob = NQueensProblem(N=10)
+    ev = M.MeshEvaluator(prob, M.make_mesh(8, mp=1))
+    B = 16
+    parents = {
+        "depth": np.full((B,), 3, dtype=np.int32),
+        "board": np.tile(np.arange(10, dtype=np.uint8), (B, 1)),
+    }
+    labels, _ = ev(parents, B, 0)
+    ref = prob.make_device_evaluator()(parents, B, 0)
+    assert np.array_equal(np.asarray(labels), np.asarray(ref))
+
+
+@pytest.mark.parametrize("lb,mp", [("lb1", 1), ("lb1_d", 1), ("lb2", 1), ("lb2", 2), ("lb2", 4)])
+def test_pfsp_mesh_matches_unsharded(lb, mp):
+    ptm = T.reduced_instance(14, jobs=8, machines=5)
+    prob = PFSPProblem(lb=lb, ub=0, p_times=ptm)
+    ev = M.MeshEvaluator(prob, M.make_mesh(8, mp=mp))
+    parents = _random_parents(8, 16, depth=3, limit1=2)
+    bounds, nbest = ev(parents, 16, 10**9)
+    ref = prob.make_device_evaluator()(parents, 16, 10**9)
+    assert np.array_equal(np.asarray(bounds), np.asarray(ref))
+    assert nbest == 10**9  # no leaf children at depth 3 of 8
+
+
+def test_pfsp_mesh_leaf_fold():
+    ptm = T.reduced_instance(14, jobs=8, machines=5)
+    prob = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    ev = M.MeshEvaluator(prob, M.make_mesh(8))
+    parents = _random_parents(8, 16, depth=7, limit1=6)
+    bounds, nbest = ev(parents, 16, 10**9)
+    ref = np.asarray(prob.make_device_evaluator()(parents, 16, 10**9))
+    assert nbest == ref[:, 7].min()
+
+
+def test_pfsp_mesh_leaf_fold_masks_padding():
+    """Padding rows beyond ``count`` must not leak into the incumbent fold,
+    even when they are leaf-shaped clones with smaller makespans."""
+    ptm = T.reduced_instance(14, jobs=8, machines=5)
+    prob = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    ev = M.MeshEvaluator(prob, M.make_mesh(8))
+    parents = _random_parents(8, 16, depth=7, limit1=6)
+    ref = np.asarray(prob.make_device_evaluator()(parents, 16, 10**9))
+    leaf_makespans = ref[:, 7]
+    # Mask all but the first 8 rows; the fold over valid rows only.
+    _, nbest = ev(parents, 8, 10**9)
+    assert nbest == leaf_makespans[:8].min()
+    # Sanity: some padding row would have changed the answer.
+    if leaf_makespans[8:].min() < leaf_makespans[:8].min():
+        assert nbest != leaf_makespans.min()

@@ -1,0 +1,74 @@
+"""Multi-device runtime tests on the virtual 8-device CPU platform
+(SURVEY.md §4 implication (b): cross-tier equivalence; (d): WS/termination
+under a fake multi-device runtime)."""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import sequential_search
+from tpu_tree_search.parallel.multidevice import multidevice_search
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard as T
+from tpu_tree_search.utils import TaskStates
+
+
+def test_task_states_sticky_allidle():
+    s = TaskStates(3)
+    assert not s.all_idle()
+    s.set_idle(0)
+    s.set_idle(1)
+    assert not s.all_idle()
+    s.set_idle(2)
+    assert s.all_idle()
+    s.set_busy(0)  # sticky: flag already latched (`util.chpl:16-21`)
+    assert s.all_idle()
+
+
+@pytest.mark.parametrize("D", [2, 4])
+def test_nqueens_multi_matches_sequential(D):
+    seq = sequential_search(NQueensProblem(N=9))
+    md = multidevice_search(NQueensProblem(N=9), m=10, M=256, D=D)
+    assert md.explored_sol == seq.explored_sol
+    assert md.explored_tree == seq.explored_tree
+    assert len(md.per_worker_tree) == D
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb2"])
+def test_pfsp_multi_finds_optimum_ub0(lb):
+    ptm = T.reduced_instance(14, jobs=7, machines=5)
+    seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm))
+    md = multidevice_search(
+        PFSPProblem(lb=lb, ub=0, p_times=ptm), m=5, M=128, D=4
+    )
+    assert md.best == seq.best
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb1_d"])
+def test_pfsp_multi_fixed_incumbent_parity(lb):
+    """With the incumbent seeded at the optimum the pruned tree is
+    partition/steal-order independent: counts must match sequential exactly
+    (the reference's ub=1 determinism invariant, SURVEY.md §4.2)."""
+    ptm = T.reduced_instance(14, jobs=8, machines=5)
+    opt = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm)).best
+    seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm), initial_best=opt)
+    md = multidevice_search(
+        PFSPProblem(lb=lb, ub=0, p_times=ptm), m=5, M=64, D=4, initial_best=opt
+    )
+    assert md.best == opt
+    assert md.explored_tree == seq.explored_tree
+    assert md.explored_sol == seq.explored_sol
+
+
+def test_multi_single_device_degenerate():
+    """D=1: no victims, termination via the sticky flag on first idle."""
+    ptm = T.reduced_instance(14, jobs=7, machines=5)
+    seq = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm))
+    md = multidevice_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm), m=5, M=64, D=1)
+    assert md.best == seq.best
+
+
+def test_workload_shares_sum_to_100():
+    md = multidevice_search(NQueensProblem(N=9), m=10, M=256, D=4)
+    shares = md.workload_shares()
+    assert len(shares) == 4
+    assert abs(sum(shares) - 100.0) < 1e-6

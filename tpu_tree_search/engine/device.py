@@ -171,7 +171,8 @@ def device_search(
     t0 = time.perf_counter()
 
     # -- step 1: warm-up ---------------------------------------------------
-    tree1, sol1, best = warmup(problem, pool, best, warmup_target or m)
+    target = m if warmup_target is None else warmup_target
+    tree1, sol1, best = warmup(problem, pool, best, target)
     t1 = time.perf_counter()
     phases.append(PhaseStats(t1 - t0, tree1, sol1))
 

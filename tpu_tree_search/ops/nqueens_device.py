@@ -9,6 +9,8 @@ work; XLA tiles it onto the VPU.
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 
@@ -45,3 +47,11 @@ def make_core(N: int, g: int = 1):
         return (safe & valid).astype(jnp.uint8)
 
     return core
+
+
+@lru_cache(maxsize=None)
+def make_jitted_core(N: int, g: int = 1):
+    """Module-level jit cache keyed on (N, g): every DeviceOffloader / worker
+    thread shares one compiled kernel per bucket shape instead of re-tracing
+    per closure (cf. the module-level jitted PFSP chunk kernels)."""
+    return jax.jit(make_core(N, g))

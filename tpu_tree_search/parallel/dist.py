@@ -1,0 +1,256 @@
+"""Distributed multi-host tier.
+
+Reproduces the reference's distributed tier semantics
+(`pfsp_dist_multigpu_chpl.chpl:313-647`; MPI baseline:
+`pfsp_dist_multigpu_cuda.c:330-816`) on jax's multi-process model:
+
+  * **warm-up**: the reference warms up on locale 0 and scatters
+    (`pfsp_dist_multigpu_chpl.chpl:339-374`). TPU hosts share no memory, so
+    instead every host runs the *identical deterministic* warm-up to
+    ``H * D * m`` nodes and takes its stride-H slice — zero communication,
+    byte-identical partitions (replicate-and-slice; the warm-up is pure
+    host compute, seconds at most).
+  * **per-host step 2**: the multi-device worker runtime (partition, work
+    stealing, idle-scan termination) over the host's local devices — exactly
+    the inner ``coforall gpuID`` tier (`pfsp_dist_multigpu_chpl.chpl:406-470`).
+  * **no inter-host stealing in v1** — the semantics of the reference's MPI
+    baseline, which only reconciles at the end
+    (`pfsp_dist_multigpu_cuda.c:570-623`, SURVEY.md §2.5). (The Chapel tier's
+    PGAS remote steals have no ICI analogue; host-RPC stealing is the
+    planned extension.)
+  * **step 3**: each host drains its own leftovers (the MPI baseline gathers
+    them to rank 0 and drains there, `pfsp_dist_multigpu_cuda.c:741-790`;
+    local drains produce the same totals without the gather).
+  * **final reductions**: tree/sol summed, best min-reduced, time max-reduced
+    across hosts — `MPI_Reduce` equivalents (`pfsp_dist_multigpu_cuda.c:680-694`)
+    over jax collectives.
+
+Communication is abstracted behind a tiny ``Collectives`` interface so the
+same driver runs: single-process (``LocalCollectives``), N virtual hosts in
+threads for testing (``ThreadCollectives``, the oversubscribed-locale
+smoke-test mode of SURVEY.md §4.6), and real multi-host pods
+(``JaxCollectives`` over jax.distributed / DCN).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..engine.results import SearchResult
+from ..problems.base import Problem
+from .multidevice import host_pipeline
+
+
+class LocalCollectives:
+    """H=1 degenerate collectives."""
+
+    num_hosts = 1
+    host_id = 0
+
+    def allreduce_sum(self, value: int) -> int:
+        return value
+
+    def allreduce_min(self, value: int) -> int:
+        return value
+
+    def allreduce_max(self, value) -> float:
+        return value
+
+
+class ThreadCollectives:
+    """In-process collectives for H virtual hosts running in threads (the
+    multi-host smoke-test mode; cf. the reference's oversubscribed UDP
+    locales, `g5k_dist_multigpu_nvidia.sh:33`)."""
+
+    def __init__(self, num_hosts: int):
+        self.num_hosts = num_hosts
+        self._barrier = threading.Barrier(num_hosts)
+        self._lock = threading.Lock()
+        self._values: list = [None] * num_hosts
+        self._local = threading.local()
+
+    def bind(self, host_id: int):
+        """Each participating thread binds its host id once."""
+        self._local.host_id = host_id
+        return self
+
+    @property
+    def host_id(self) -> int:
+        return self._local.host_id
+
+    def _exchange(self, value):
+        self._values[self.host_id] = value
+        self._barrier.wait()
+        vals = list(self._values)
+        self._barrier.wait()
+        return vals
+
+    def allreduce_sum(self, value):
+        return sum(self._exchange(value))
+
+    def allreduce_min(self, value):
+        return min(self._exchange(value))
+
+    def allreduce_max(self, value):
+        return max(self._exchange(value))
+
+
+class JaxCollectives:
+    """Real multi-host collectives over jax.distributed (DCN). The launcher
+    must have called ``jax.distributed.initialize``; every host participates
+    in every call (the reductions happen only at start/end, mirroring the
+    MPI baseline's join-point-only communication, SURVEY.md §2.5)."""
+
+    def __init__(self):
+        import jax
+
+        self.num_hosts = jax.process_count()
+        self.host_id = jax.process_index()
+
+    def _allgather(self, value):
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(np.asarray([value]))
+        ).reshape(-1)
+
+    def allreduce_sum(self, value):
+        return type(value)(self._allgather(value).sum())
+
+    def allreduce_min(self, value):
+        return type(value)(self._allgather(value).min())
+
+    def allreduce_max(self, value):
+        return type(value)(self._allgather(value).max())
+
+
+def _host_search(
+    problem: Problem,
+    m: int,
+    M: int,
+    D: int,
+    devices,
+    collectives,
+    initial_best: int | None,
+    share_bound: bool,
+    seed_base: int = 0xD157,
+):
+    """One host's full pipeline (warm-up + stride slice, local multi-device
+    runtime, local drain); returns its local stats for reduction. Delegates
+    to the shared ``host_pipeline`` (SURVEY.md §1: the reference duplicates
+    this scaffolding between its multi and dist mains — we don't)."""
+    return host_pipeline(
+        problem, m, M, D, devices,
+        initial_best=initial_best, share_bound=share_bound,
+        num_hosts=collectives.num_hosts, host_id=collectives.host_id,
+        seed=seed_base + collectives.host_id,
+    )
+
+
+def _reduce(local: dict, collectives) -> SearchResult:
+    """`MPI_Reduce` equivalents: sum tree/sol, min best, max time
+    (`pfsp_dist_multigpu_cuda.c:680-694`)."""
+    tree = collectives.allreduce_sum(local["tree"])
+    sol = collectives.allreduce_sum(local["sol"])
+    best = collectives.allreduce_min(local["best"])
+    elapsed = collectives.allreduce_max(local["elapsed"])
+    return SearchResult(
+        explored_tree=tree,
+        explored_sol=sol,
+        best=best,
+        elapsed=elapsed,
+        phases=local["phases"],
+        diagnostics=local["diag"],
+        per_worker_tree=local["per_worker_tree"],
+    )
+
+
+def dist_search(
+    problem: Problem,
+    m: int = 25,
+    M: int = 50000,
+    D: int | None = None,
+    num_hosts: int | None = None,
+    devices=None,
+    initial_best: int | None = None,
+    share_bound: bool = True,
+) -> SearchResult:
+    """Distributed search entry point.
+
+    * Under ``jax.distributed`` (process_count > 1): this process runs its
+      host's share; reductions go over DCN. Returns the global result.
+    * Single process with ``num_hosts=H > 1``: runs H virtual hosts in
+      threads over disjoint local-device groups (testing mode).
+    * Single process, ``num_hosts`` unset/1: degenerates to one host.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        coll = JaxCollectives()
+        local_devices = jax.local_devices() if devices is None else devices
+        if D is None:
+            D = len(local_devices)
+        local = _host_search(
+            problem, m, M, D, local_devices, coll, initial_best, share_bound
+        )
+        return _reduce(local, coll)
+
+    all_devices = jax.devices() if devices is None else devices
+    H = num_hosts or 1
+    if H == 1:
+        coll = LocalCollectives()
+        if D is None:
+            D = len(all_devices)
+        local = _host_search(
+            problem, m, M, D, all_devices, coll, initial_best, share_bound
+        )
+        return _reduce(local, coll)
+
+    # Virtual hosts: split local devices into H disjoint groups.
+    if H > len(all_devices):
+        raise ValueError(
+            f"num_hosts={H} exceeds available devices ({len(all_devices)}); "
+            "virtual hosts need at least one device each"
+        )
+    groups = [all_devices[h::H] for h in range(H)]
+    if D is None:
+        D = max(1, min(len(g) for g in groups))
+    coll = ThreadCollectives(H)
+    results: list = [None] * H
+    errors: list = [None] * H
+
+    def host_main(h: int):
+        try:
+            results[h] = _reduce(
+                _host_search(
+                    problem, m, M, D, groups[h], coll.bind(h),
+                    initial_best, share_bound,
+                ),
+                coll,
+            )
+        except BaseException as e:  # propagate after join
+            errors[h] = e
+            try:
+                coll._barrier.abort()
+            except Exception:
+                pass
+
+    threads = [
+        threading.Thread(target=host_main, args=(h,), name=f"tts-host-{h}")
+        for h in range(H)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errors:
+        if e is not None:
+            raise e
+    # All hosts computed identical global reductions; merge per-host extras.
+    global_res = results[0]
+    global_res.per_worker_tree = [
+        t for r in results for t in r.per_worker_tree
+    ]
+    return global_res

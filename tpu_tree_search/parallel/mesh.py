@@ -137,15 +137,15 @@ class MeshEvaluator:
                 P("mp", None),  # johnson_schedules
             )
 
-            @partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+            @partial(jax.shard_map, mesh=mesh, in_specs=(*in_specs, P()),
                      out_specs=(P("dp", None), P()))
-            def step(parents, best, ptm_t, min_heads, min_tails, prs, lgs, sch):
+            def step(parents, best, ptm_t, min_heads, min_tails, prs, lgs, sch, count):
                 local = pfsp_device._lb2_chunk(
                     parents["prmu"], parents["limit1"], ptm_t,
                     min_heads, min_tails, prs, lgs, sch,
                 )
                 bounds = jax.lax.pmax(local, "mp")  # combine pair subsets
-                new_best = _fold_leaf_best(parents, bounds, best, jobs)
+                new_best = _fold_leaf_best(parents, bounds, best, jobs, count)
                 return bounds, new_best
 
             args = (
@@ -159,13 +159,13 @@ class MeshEvaluator:
             )
             in_specs = (node_spec, P(), P(None, None), P(None), P(None))
 
-            @partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+            @partial(jax.shard_map, mesh=mesh, in_specs=(*in_specs, P()),
                      out_specs=(P("dp", None), P()))
-            def step(parents, best, ptm_t, min_heads, min_tails):
+            def step(parents, best, ptm_t, min_heads, min_tails, count):
                 bounds = chunk(
                     parents["prmu"], parents["limit1"], ptm_t, min_heads, min_tails
                 )
-                new_best = _fold_leaf_best(parents, bounds, best, jobs)
+                new_best = _fold_leaf_best(parents, bounds, best, jobs, count)
                 return bounds, new_best
 
             args = (
@@ -176,8 +176,9 @@ class MeshEvaluator:
         jitted = jax.jit(step)
 
         def run(parents, count, best):
-            del count
-            bounds, new_best = jitted(parents, jnp.int32(best), *args)
+            bounds, new_best = jitted(
+                parents, jnp.int32(best), *args, jnp.int32(count)
+            )
             return bounds, int(new_best)
 
         return jitted, run
@@ -192,17 +193,26 @@ class MeshEvaluator:
         return run(parents, count, best)
 
 
-def _fold_leaf_best(parents, bounds, best, jobs):
-    """Mesh-wide incumbent fold: min over this shard's leaf-child makespans,
-    then pmin across dp (the in-step UB all-reduce; mp shards share identical
-    leaf values after pmax so pmin over dp suffices — pmin over mp would also
-    be a no-op).
+def _fold_leaf_best(parents, bounds, best, jobs, count):
+    """Mesh-wide incumbent fold: min over this shard's *valid* leaf-child
+    makespans, then pmin across dp (the in-step UB all-reduce; mp shards
+    share identical leaf values after pmax so pmin over dp suffices).
+
+    Rows at global index >= count are padding (the engine pads chunks to the
+    bucket/mesh size) and are masked out of the fold — their bounds must not
+    corrupt the incumbent.
     """
     depth = parents["depth"]
     limit1 = parents["limit1"]
+    local_b = bounds.shape[0]
+    row = (
+        jax.lax.axis_index("dp") * local_b
+        + jnp.arange(local_b, dtype=jnp.int32)
+    )
+    valid_row = row < count  # (local_b,)
     j = jnp.arange(bounds.shape[1], dtype=jnp.int32)[None, :]
     open_slot = j >= (limit1[:, None] + 1)
-    is_leaf = jnp.logical_and(depth[:, None] + 1 == jobs, open_slot)
+    is_leaf = (depth[:, None] + 1 == jobs) & open_slot & valid_row[:, None]
     leaf_min = jnp.min(jnp.where(is_leaf, bounds, jnp.int32(INF_BOUND)))
     new_best = jnp.minimum(jnp.int32(best), leaf_min)
     return jax.lax.pmin(new_best, "dp")

@@ -1,0 +1,308 @@
+"""Multi-device search runtime: one host thread + private pool per device,
+static initial partition, work stealing, idle-scan termination.
+
+Reproduces the reference's multi-GPU tier semantics
+(`pfsp_multigpu_chpl.chpl:312-535`, `nqueens_multigpu_chpl.chpl:152-346`):
+
+  * warm-up on the main thread until the global pool holds ``D * m`` nodes
+    (`nqueens_multigpu_chpl.chpl:173`);
+  * static round-robin partition — worker w receives elements w, w+D, w+2D …
+    of the warm pool, so adjacent (sibling) subtrees land on different
+    devices (`nqueens_multigpu_chpl.chpl:221-225`);
+  * each worker snapshots the incumbent (``best_l``) at partition time and
+    prunes against it privately; incumbents reconcile at the terminal
+    min-reduction — the reference's lazy-UB design (SURVEY.md §2.4.4). A
+    ``share_bound`` flag adds the mid-search improvement the reference
+    lacks: workers publish/adopt the global best between chunks;
+  * work stealing when a worker's pool runs dry: victims in random order
+    (`permute`, `nqueens_multigpu_chpl.chpl:441`), up to 10 lock attempts
+    per victim, steal **half the victim's front** iff its size >= 2m
+    (`Pool_par.chpl:180-191`);
+  * termination: idle-state array + sticky-flag allIdle scan
+    (`util.chpl:16-30`); workers flip BUSY again on new work;
+  * leftovers drain back to the global pool, stats reduce at the join
+    (`pfsp_multigpu_chpl.chpl:498-520`), final CPU drain on the main thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..engine.device import DeviceOffloader, bucket_size, drain, warmup
+from ..engine.results import Diagnostics, PhaseStats, SearchResult
+from ..pool import ParallelSoAPool, SoAPool
+from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
+from ..utils import TaskStates
+
+
+class _SharedBest:
+    """Optional mid-search incumbent exchange (improvement over the
+    reference's terminal-only reconciliation, BASELINE.json north star)."""
+
+    def __init__(self, value: int):
+        self._value = value
+        self._lock = threading.Lock()
+
+    def publish(self, value: int) -> int:
+        with self._lock:
+            if value < self._value:
+                self._value = value
+            return self._value
+
+    def read(self) -> int:
+        return self._value
+
+
+class _Worker:
+    def __init__(self, wid: int, problem: Problem, pool: ParallelSoAPool, device):
+        self.wid = wid
+        self.problem = problem
+        self.pool = pool
+        self.device = device
+        self.tree = 0
+        self.sol = 0
+        self.best = INF_BOUND
+        self.steals = 0
+        self.diagnostics = Diagnostics()
+        self.error: BaseException | None = None
+
+
+def _partition(problem: Problem, pool: SoAPool, D: int) -> list[ParallelSoAPool]:
+    """Static stride-D split of the warm pool
+    (`nqueens_multigpu_chpl.chpl:199-225`): worker w gets elements w::D."""
+    batch = pool.as_batch()
+    pools = []
+    for w in range(D):
+        p = ParallelSoAPool(problem.node_fields())
+        p.push_back_bulk({k: v[w::D] for k, v in batch.items()})
+        pools.append(p)
+    return pools
+
+
+def _worker_loop(
+    w: _Worker,
+    pools: list[ParallelSoAPool],
+    states: TaskStates,
+    m: int,
+    M: int,
+    shared: _SharedBest | None,
+    rng: np.random.Generator,
+):
+    problem = w.problem
+    try:
+        off = DeviceOffloader(problem, w.device)
+        w.diagnostics = off.diagnostics
+        D = len(pools)
+        chunk_buf = problem.empty_batch(M)
+        while True:
+            count = w.pool.locked_pop_back_bulk(m, M, chunk_buf)
+            if count > 0:
+                states.set_busy(w.wid)  # `pfsp_multigpu_chpl.chpl:416-419`
+                if shared is not None:
+                    w.best = min(w.best, shared.read())
+                bucket = bucket_size(count, m, M)
+                snapshot = {k: v[:count].copy() for k, v in chunk_buf.items()}
+                dev_result = off.dispatch(snapshot, count, bucket, w.best)
+                results = off.collect(dev_result)
+                res = problem.generate_children(snapshot, count, results, w.best)
+                w.tree += res.tree_inc
+                w.sol += res.sol_inc
+                if res.best < w.best:
+                    w.best = res.best
+                    if shared is not None:
+                        w.best = shared.publish(w.best)
+                w.pool.locked_push_back_bulk(res.children)
+                continue
+            # -- work stealing (`pfsp_multigpu_chpl.chpl:438-479`) ---------
+            stolen = False
+            for victim_id in rng.permutation(D):
+                if victim_id == w.wid:
+                    continue
+                victim = pools[victim_id]
+                for _ in range(10):  # lock attempts cap, `Pool_par` call sites
+                    if victim.try_lock():
+                        try:
+                            batch = victim.pop_front_bulk_half(m)
+                        finally:
+                            victim.unlock()
+                        if batch is not None:
+                            w.pool.locked_push_back_bulk(batch)
+                            w.steals += 1
+                            stolen = True
+                        break
+                    time.sleep(0)  # yieldExecution backoff
+                if stolen:
+                    break
+            if stolen:
+                states.set_busy(w.wid)
+                continue
+            # -- termination (`pfsp_multigpu_chpl.chpl:481-495`) -----------
+            states.set_idle(w.wid)
+            if states.all_idle():
+                return
+            time.sleep(0)
+    except BaseException as e:  # surface into the main thread
+        w.error = e
+        states.set_idle(w.wid)
+        states.flag.set()  # unblock everyone; search aborts
+
+
+def run_workers(
+    problem: Problem,
+    pool: SoAPool,
+    D: int,
+    assigned,
+    m: int,
+    M: int,
+    best: int,
+    share_bound: bool = True,
+    seed: int = 0xB0B,
+):
+    """Step 2 of the multi-device tier: partition ``pool`` across D worker
+    threads, run the offload/steal/terminate loops, join, and merge leftovers
+    back into a fresh global pool. Returns
+    ``(leftover_pool, tree2, sol2, best, workers)``. Shared by the
+    single-host multi tier and the per-host phase of the distributed tier
+    (the reference duplicates this scaffolding between its multi and dist
+    mains, SURVEY.md §1 note).
+    """
+    pools = _partition(problem, pool, D)
+    leftover = SoAPool(problem.node_fields())
+    states = TaskStates(D)
+    shared = _SharedBest(best) if share_bound else None
+    workers = [_Worker(w, problem, pools[w], assigned[w]) for w in range(D)]
+    for w in workers:
+        w.best = best
+    seeds = np.random.SeedSequence(seed)
+    threads = [
+        threading.Thread(
+            target=_worker_loop,
+            args=(w, pools, states, m, M, shared, np.random.default_rng(s)),
+            name=f"tts-worker-{w.wid}",
+        )
+        for w, s in zip(workers, seeds.spawn(D))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for w in workers:
+        if w.error is not None:
+            raise w.error
+    # leftovers back into the global pool (`pfsp_multigpu_chpl.chpl:498-503`)
+    for p in pools:
+        leftover.push_back_bulk(p.as_batch())
+    tree2 = sum(w.tree for w in workers)
+    sol2 = sum(w.sol for w in workers)
+    best = min([best] + [w.best for w in workers])  # min-reduce (`:518-520`)
+    return leftover, tree2, sol2, best, workers
+
+
+def host_pipeline(
+    problem: Problem,
+    m: int,
+    M: int,
+    D: int,
+    devices,
+    initial_best: int | None = None,
+    share_bound: bool = True,
+    num_hosts: int = 1,
+    host_id: int = 0,
+    seed: int = 0xB0B,
+) -> dict:
+    """The full 3-phase pipeline one host runs: warm-up, partitioned
+    parallel offload (work stealing + termination), drain.
+
+    With ``num_hosts == 1`` this is the whole multi-GPU tier
+    (`pfsp_multigpu_chpl.chpl:312-535`). With H hosts, every host runs the
+    identical deterministic warm-up to ``H*D*m`` and takes its stride-H
+    slice — the locale-level round-robin partition of the dist tier
+    (`pfsp_dist_multigpu_chpl.chpl:339-374`) without communication; host 0
+    owns the warm-up counters so the cross-host sum counts them once.
+    Returns a dict of local stats for (cross-host) reduction.
+    """
+    # One thread per device; if D exceeds physical devices, oversubscribe
+    # round-robin (the CPU-mesh testing mode, SURVEY.md §4.6).
+    assigned = [devices[w % len(devices)] for w in range(D)]
+
+    best = (
+        initial_best
+        if initial_best is not None
+        else getattr(problem, "initial_ub", INF_BOUND)
+    )
+    pool = SoAPool(problem.node_fields())
+    pool.push_back(index_batch(problem.root(), 0))
+
+    t0 = time.perf_counter()
+
+    # -- step 1: warm-up to H*D*m (`nqueens_multigpu_chpl.chpl:173`,
+    # dist target `pfsp_dist_multigpu_chpl.chpl:339-345`) ------------------
+    tree1, sol1, best = warmup(problem, pool, best, num_hosts * D * m)
+    if num_hosts > 1:
+        warm = pool.as_batch()
+        pool = SoAPool(problem.node_fields())
+        pool.push_back_bulk({k: v[host_id::num_hosts] for k, v in warm.items()})
+        if host_id != 0:
+            tree1 = sol1 = 0
+    t1 = time.perf_counter()
+
+    # -- step 2: partitioned parallel offload ------------------------------
+    pool, tree2, sol2, best, workers = run_workers(
+        problem, pool, D, assigned, m, M, best, share_bound, seed=seed
+    )
+    t2 = time.perf_counter()
+
+    # -- step 3: drain (`pfsp_multigpu_chpl.chpl:529-535`) -----------------
+    tree3, sol3, best = drain(problem, pool, best)
+    t3 = time.perf_counter()
+
+    diag = Diagnostics(
+        kernel_launches=sum(w.diagnostics.kernel_launches for w in workers),
+        host_to_device=sum(w.diagnostics.host_to_device for w in workers),
+        device_to_host=sum(w.diagnostics.device_to_host for w in workers),
+    )
+    return {
+        "tree": tree1 + tree2 + tree3,
+        "sol": sol1 + sol2 + sol3,
+        "best": best,
+        "phases": [
+            PhaseStats(t1 - t0, tree1, sol1),
+            PhaseStats(t2 - t1, tree2, sol2),
+            PhaseStats(t3 - t2, tree3, sol3),
+        ],
+        "elapsed": t3 - t0,
+        "per_worker_tree": [w.tree for w in workers],
+        "diag": diag,
+    }
+
+
+def multidevice_search(
+    problem: Problem,
+    m: int = 25,
+    M: int = 50000,
+    D: int | None = None,
+    devices=None,
+    initial_best: int | None = None,
+    share_bound: bool = True,
+) -> SearchResult:
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if D is None:
+        D = len(devices)
+    local = host_pipeline(
+        problem, m, M, D, devices, initial_best, share_bound
+    )
+    return SearchResult(
+        explored_tree=local["tree"],
+        explored_sol=local["sol"],
+        best=local["best"],
+        elapsed=local["elapsed"],
+        phases=local["phases"],
+        diagnostics=local["diag"],
+        per_worker_tree=local["per_worker_tree"],
+    )

@@ -16,8 +16,6 @@ invariant, SURVEY.md §4.2):
 
 from __future__ import annotations
 
-from functools import partial
-
 import numpy as np
 
 from .base import DecomposeResult, NodeBatch, Problem
@@ -82,13 +80,10 @@ class NQueensProblem(Problem):
     # -- device path -------------------------------------------------------
 
     def make_device_evaluator(self):
-        import jax
-
         from ..ops import nqueens_device
 
-        core = nqueens_device.make_core(self.N, self.g)
+        core = nqueens_device.make_jitted_core(self.N, self.g)
 
-        @partial(jax.jit, static_argnums=())
         def evaluate(parents, count, best):
             """Batched safety labels, one slot per (parent, candidate column)
             (`nqueens_gpu_chpl.chpl:97-123`)."""

@@ -150,8 +150,14 @@ class PFSPProblem(Problem):
     def make_device_evaluator(self):
         from ...ops import pfsp_device
 
-        tables = pfsp_device.PFSPDeviceTables(self.lb1_data, self.lb2_data)
-        return pfsp_device.make_evaluator(tables, self.lb)
+        # Tables are built once per problem instance and shared by all
+        # offloaders/workers (the chunk kernels themselves are module-level
+        # jits, so the compile cache is shared too).
+        if not hasattr(self, "_device_tables"):
+            self._device_tables = pfsp_device.PFSPDeviceTables(
+                self.lb1_data, self.lb2_data
+            )
+        return pfsp_device.make_evaluator(self._device_tables, self.lb)
 
     def generate_children(
         self, parents: NodeBatch, count: int, results: np.ndarray, best: int

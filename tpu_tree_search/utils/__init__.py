@@ -1,0 +1,5 @@
+"""Shared runtime utilities: idle-state tracking and termination detection."""
+
+from .termination import BUSY, IDLE, TaskStates
+
+__all__ = ["BUSY", "IDLE", "TaskStates"]
