@@ -1,0 +1,187 @@
+"""Native (C++) host runtime vs Python oracle parity.
+
+The C++ library (`csrc/tts_native.cpp`) must reproduce the Python engines'
+counts and traversal *order* exactly: the distributed tier's static partition
+slices the warm-up frontier positionally, so even frontier ordering is a
+semantic contract, not an implementation detail (SURVEY.md Appendix A).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search import native
+from tpu_tree_search.engine import sequential_search
+from tpu_tree_search.engine.device import drain, warmup
+from tpu_tree_search.pool import SoAPool
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.base import INF_BOUND, index_batch
+from tpu_tree_search.problems.pfsp import taillard as T
+
+if native.load() is None:
+    pytest.skip(
+        f"native library unavailable: {native.load_error()}",
+        allow_module_level=True,
+    )
+
+
+def _python_only(problem):
+    """Return the same problem with the native runtime disabled."""
+    problem._native_rt = None
+    return problem
+
+
+def _seed_pool(problem):
+    pool = SoAPool(problem.node_fields())
+    pool.push_back(index_batch(problem.root(), 0))
+    return pool
+
+
+# -- sequential tier ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [6, 8, 9])
+def test_nqueens_sequential_parity(n):
+    res_nat = sequential_search(NQueensProblem(N=n))
+    res_py = sequential_search(_python_only(NQueensProblem(N=n)))
+    assert (res_nat.explored_tree, res_nat.explored_sol) == (
+        res_py.explored_tree,
+        res_py.explored_sol,
+    )
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb1_d", "lb2"])
+@pytest.mark.parametrize("ub_seed", [False, True])
+def test_pfsp_sequential_parity(lb, ub_seed):
+    """ub=0 (evolving incumbent) is the strong test: any traversal-order
+    difference changes the explored tree."""
+    ptm = T.reduced_instance(14, jobs=7, machines=5)
+
+    def run(problem):
+        best = 1_000_000 if ub_seed else None
+        return sequential_search(problem, initial_best=best)
+
+    res_nat = run(PFSPProblem(lb=lb, ub=0, p_times=ptm))
+    res_py = run(_python_only(PFSPProblem(lb=lb, ub=0, p_times=ptm)))
+    assert (res_nat.explored_tree, res_nat.explored_sol, res_nat.best) == (
+        res_py.explored_tree,
+        res_py.explored_sol,
+        res_py.best,
+    )
+
+
+# -- warm-up / drain phases --------------------------------------------------
+
+
+def test_nqueens_warmup_frontier_identical():
+    target = 50
+    p_nat = NQueensProblem(N=9)
+    p_py = _python_only(NQueensProblem(N=9))
+    pool_nat, pool_py = _seed_pool(p_nat), _seed_pool(p_py)
+    out_nat = warmup(p_nat, pool_nat, INF_BOUND, target)
+    out_py = warmup(p_py, pool_py, INF_BOUND, target)
+    assert out_nat == out_py
+    b_nat, b_py = pool_nat.as_batch(), pool_py.as_batch()
+    assert pool_nat.size == pool_py.size
+    np.testing.assert_array_equal(b_nat["depth"], b_py["depth"])
+    np.testing.assert_array_equal(b_nat["board"], b_py["board"])
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb2"])
+def test_pfsp_warmup_frontier_identical(lb):
+    ptm = T.reduced_instance(3, jobs=8, machines=5)
+    target = 60
+    p_nat = PFSPProblem(lb=lb, ub=0, p_times=ptm)
+    p_py = _python_only(PFSPProblem(lb=lb, ub=0, p_times=ptm))
+    pool_nat, pool_py = _seed_pool(p_nat), _seed_pool(p_py)
+    out_nat = warmup(p_nat, pool_nat, INF_BOUND, target)
+    out_py = warmup(p_py, pool_py, INF_BOUND, target)
+    assert out_nat == out_py
+    b_nat, b_py = pool_nat.as_batch(), pool_py.as_batch()
+    for field in ("depth", "limit1", "prmu"):
+        np.testing.assert_array_equal(b_nat[field], b_py[field])
+
+
+def test_pfsp_drain_parity():
+    ptm = T.reduced_instance(5, jobs=8, machines=5)
+    p_nat = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    p_py = _python_only(PFSPProblem(lb="lb1", ub=0, p_times=ptm))
+    pool_nat, pool_py = _seed_pool(p_nat), _seed_pool(p_py)
+    warmup(p_nat, pool_nat, INF_BOUND, 40)
+    warmup(p_py, pool_py, INF_BOUND, 40)
+    out_nat = drain(p_nat, pool_nat, INF_BOUND)
+    out_py = drain(p_py, pool_py, INF_BOUND)
+    assert out_nat == out_py
+    assert pool_nat.size == 0
+
+
+# -- generate_children (device-result consumption) ---------------------------
+
+
+def _random_pfsp_parents(rng, jobs, count):
+    prmu = np.tile(np.arange(jobs, dtype=np.int32), (count, 1))
+    for row in prmu:
+        rng.shuffle(row)
+    limit1 = rng.integers(-1, jobs - 1, size=count).astype(np.int32)
+    depth = (limit1 + 1).astype(np.int32)
+    return {"depth": depth, "limit1": limit1, "prmu": prmu}
+
+
+def test_pfsp_generate_children_parity():
+    rng = np.random.default_rng(7)
+    jobs = 9
+    ptm = T.reduced_instance(2, jobs=jobs, machines=4)
+    p_nat = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    p_py = _python_only(PFSPProblem(lb="lb1", ub=0, p_times=ptm))
+    for _ in range(20):
+        count = int(rng.integers(1, 40))
+        parents = _random_pfsp_parents(rng, jobs, count)
+        bounds = rng.integers(0, 2000, size=(count, jobs)).astype(np.int32)
+        best = int(rng.integers(500, 1500))
+        r_nat = p_nat.generate_children(parents, count, bounds, best)
+        r_py = p_py.generate_children(parents, count, bounds, best)
+        assert (r_nat.tree_inc, r_nat.sol_inc, r_nat.best) == (
+            r_py.tree_inc,
+            r_py.sol_inc,
+            r_py.best,
+        )
+        for field in ("depth", "limit1", "prmu"):
+            np.testing.assert_array_equal(
+                r_nat.children[field], r_py.children[field]
+            )
+
+
+def test_nqueens_generate_children_parity():
+    rng = np.random.default_rng(3)
+    N = 8
+    p_nat = NQueensProblem(N=N)
+    p_py = _python_only(NQueensProblem(N=N))
+    for _ in range(20):
+        count = int(rng.integers(1, 30))
+        boards = np.tile(np.arange(N, dtype=np.uint8), (count, 1))
+        for row in boards:
+            rng.shuffle(row)
+        depth = rng.integers(0, N + 1, size=count).astype(np.int32)
+        parents = {"depth": depth, "board": boards}
+        labels = rng.integers(0, 2, size=(count, N)).astype(np.uint8)
+        r_nat = p_nat.generate_children(parents, count, labels, INF_BOUND)
+        r_py = p_py.generate_children(parents, count, labels, INF_BOUND)
+        assert (r_nat.tree_inc, r_nat.sol_inc) == (r_py.tree_inc, r_py.sol_inc)
+        for field in ("depth", "board"):
+            np.testing.assert_array_equal(
+                r_nat.children[field], r_py.children[field]
+            )
+
+
+# -- full offload tier with the native host path -----------------------------
+
+
+def test_device_search_native_matches_sequential():
+    from tpu_tree_search.engine.device import device_search
+
+    prob = NQueensProblem(N=9)
+    seq = sequential_search(NQueensProblem(N=9))
+    res = device_search(prob, m=8, M=512)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree,
+        seq.explored_sol,
+    )

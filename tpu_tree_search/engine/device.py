@@ -119,6 +119,12 @@ def warmup(problem: Problem, pool: SoAPool, best: int, target: int):
     pool is shallow-first (SURVEY.md Appendix A warm-up note).
     Returns (tree_inc, sol_inc, best).
     """
+    if pool.size > 0 and pool.size < target:
+        native = problem.native_warmup(pool.as_batch(), best, target)
+        if native is not None:
+            frontier, tree, sol, best = native
+            pool.reset_from(frontier)
+            return tree, sol, best
     tree = 0
     sol = 0
     while pool.size > 0 and pool.size < target:
@@ -133,6 +139,11 @@ def warmup(problem: Problem, pool: SoAPool, best: int, target: int):
 
 def drain(problem: Problem, pool: SoAPool, best: int):
     """Step 3: host DFS of whatever is left (`nqueens_gpu_chpl.chpl:230-236`)."""
+    if pool.size > 0:
+        native = problem.native_drain(pool.as_batch(), best)
+        if native is not None:
+            pool.reset_from(problem.empty_batch(0))
+            return native
     tree = 0
     sol = 0
     while True:

@@ -21,13 +21,25 @@ def sequential_search(problem: Problem, initial_best: int | None = None) -> Sear
         if initial_best is not None
         else getattr(problem, "initial_ub", INF_BOUND)
     )
+    t0 = time.perf_counter()
+    native = problem.native_sequential(best)
+    if native is not None:
+        tree, sol, best = native
+        elapsed = time.perf_counter() - t0
+        return SearchResult(
+            explored_tree=tree,
+            explored_sol=sol,
+            best=best,
+            elapsed=elapsed,
+            phases=[PhaseStats(elapsed, tree, sol)],
+        )
+
     pool = SoAPool(problem.node_fields())
     root = problem.root()
     pool.push_back(index_batch(root, 0))
 
     tree = 0
     sol = 0
-    t0 = time.perf_counter()
     while True:
         node = pool.pop_back()
         if node is None:

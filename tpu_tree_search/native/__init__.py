@@ -1,0 +1,308 @@
+"""Native (C++) host runtime bindings.
+
+The reference's host path is C (`baselines/*/lib/*.c`); ours is
+`csrc/tts_native.cpp`, compiled lazily into a shared library and bound via
+ctypes (no pybind11 in the image). The Python implementations in
+`problems/` and `engine/` remain the semantic oracles and the portable
+fallback; everything here is property-tested against them.
+
+Set ``TTS_NATIVE=0`` to force the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parents[2] / "csrc" / "tts_native.cpp"
+_BUILD_DIR = _SRC.parent / "_build"
+
+_lock = threading.Lock()
+_lib = None
+_lib_error: str | None = None
+
+
+def _compile() -> Path:
+    flags = ["-O3", "-std=c++17", "-shared", "-fPIC"]
+    src_text = _SRC.read_text()
+    tag = hashlib.sha256((src_text + " ".join(flags)).encode()).hexdigest()[:16]
+    out = _BUILD_DIR / f"libtts_native_{tag}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    # Per-process tmp name: concurrent builders (pytest workers, parallel CLI
+    # runs) must not write through the same inode before the atomic rename.
+    tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
+    cmd = [os.environ.get("CXX", "g++"), *flags, "-o", str(tmp), str(_SRC)]
+    # -march=native when the toolchain supports it (it may not in a sandbox).
+    probe = subprocess.run(
+        cmd[:1] + ["-march=native", "-E", "-x", "c++", "-", "-o", os.devnull],
+        input=b"",
+        capture_output=True,
+    )
+    if probe.returncode == 0:
+        cmd.insert(1, "-march=native")
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, out)
+    return out
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.tts_nq_sequential.argtypes = [ctypes.c_int32, ctypes.c_int32, i64p, i64p]
+    lib.tts_nq_sequential.restype = None
+    lib.tts_nq_warmup.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        i32p, u8p, ctypes.c_int64, i64p, i64p,
+    ]
+    lib.tts_nq_warmup.restype = ctypes.c_int64
+    lib.tts_nq_drain.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, i32p, u8p, ctypes.c_int64, i64p, i64p,
+    ]
+    lib.tts_nq_drain.restype = None
+    lib.tts_nq_generate.argtypes = [
+        ctypes.c_int32, i32p, u8p, ctypes.c_int64, u8p, i32p, u8p, i64p,
+    ]
+    lib.tts_nq_generate.restype = ctypes.c_int64
+    lib.tts_pfsp_new.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p, i32p, ctypes.c_int32, i32p, i32p, i32p,
+    ]
+    lib.tts_pfsp_new.restype = ctypes.c_void_p
+    lib.tts_pfsp_free.argtypes = [ctypes.c_void_p]
+    lib.tts_pfsp_free.restype = None
+    lib.tts_pfsp_sequential.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i32p,
+    ]
+    lib.tts_pfsp_sequential.restype = None
+    lib.tts_pfsp_warmup.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, i32p, i32p, i32p,
+        ctypes.c_int64, i64p, i64p, i32p,
+    ]
+    lib.tts_pfsp_warmup.restype = ctypes.c_int64
+    lib.tts_pfsp_drain.argtypes = [
+        ctypes.c_void_p, i32p, i32p, i32p, ctypes.c_int64, i64p, i64p, i32p,
+    ]
+    lib.tts_pfsp_drain.restype = None
+    lib.tts_pfsp_generate.argtypes = [
+        ctypes.c_void_p, i32p, i32p, i32p, ctypes.c_int64, i32p,
+        i32p, i32p, i32p, i64p, i32p,
+    ]
+    lib.tts_pfsp_generate.restype = ctypes.c_int64
+
+
+def load():
+    """Compile-on-demand loader; returns the CDLL or None (with the failure
+    reason kept in ``load_error()``)."""
+    global _lib, _lib_error
+    if os.environ.get("TTS_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _lib_error is not None:
+            return _lib
+        try:
+            path = _compile()
+            lib = ctypes.CDLL(str(path))
+            _declare(lib)
+            _lib = lib
+        except subprocess.CalledProcessError as e:
+            stderr = (e.stderr or b"").decode(errors="replace").strip()
+            _lib_error = f"native build failed: {stderr or e}"
+        except Exception as e:  # missing toolchain, sandbox, ...
+            _lib_error = f"{type(e).__name__}: {e}"
+        return _lib
+
+
+def load_error() -> str | None:
+    return _lib_error
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _i32(arr):
+    return _ptr(arr, ctypes.c_int32)
+
+
+def _u8(arr):
+    return _ptr(arr, ctypes.c_uint8)
+
+
+class NativeNQueens:
+    """Native host primitives for one N-Queens configuration."""
+
+    def __init__(self, lib: ctypes.CDLL, N: int, g: int):
+        self._lib = lib
+        self.N = N
+        self.g = g
+
+    def sequential(self) -> tuple[int, int]:
+        tree = ctypes.c_int64()
+        sol = ctypes.c_int64()
+        self._lib.tts_nq_sequential(
+            self.N, self.g, ctypes.byref(tree), ctypes.byref(sol)
+        )
+        return tree.value, sol.value
+
+    def warmup(self, batch: dict, target: int) -> tuple[dict, int, int]:
+        size_in = batch["depth"].shape[0]
+        cap = max(size_in, target + self.N)
+        depth = np.zeros(cap, dtype=np.int32)
+        board = np.zeros((cap, self.N), dtype=np.uint8)
+        depth[:size_in] = batch["depth"]
+        board[:size_in] = batch["board"]
+        tree = ctypes.c_int64()
+        sol = ctypes.c_int64()
+        out = self._lib.tts_nq_warmup(
+            self.N, self.g, target, _i32(depth), _u8(board), size_in,
+            ctypes.byref(tree), ctypes.byref(sol),
+        )
+        frontier = {"depth": depth[:out].copy(), "board": board[:out].copy()}
+        return frontier, tree.value, sol.value
+
+    def drain(self, batch: dict) -> tuple[int, int]:
+        size = batch["depth"].shape[0]
+        depth = np.ascontiguousarray(batch["depth"], dtype=np.int32)
+        board = np.ascontiguousarray(batch["board"], dtype=np.uint8)
+        tree = ctypes.c_int64()
+        sol = ctypes.c_int64()
+        self._lib.tts_nq_drain(
+            self.N, self.g, _i32(depth), _u8(board), size,
+            ctypes.byref(tree), ctypes.byref(sol),
+        )
+        return tree.value, sol.value
+
+    def generate_children(
+        self, parents: dict, count: int, labels: np.ndarray
+    ) -> tuple[dict, int, int]:
+        pdepth = np.ascontiguousarray(parents["depth"][:count], dtype=np.int32)
+        pboard = np.ascontiguousarray(parents["board"][:count], dtype=np.uint8)
+        lab = np.ascontiguousarray(labels[:count], dtype=np.uint8)
+        cap = count * self.N
+        cdepth = np.zeros(cap, dtype=np.int32)
+        cboard = np.zeros((cap, self.N), dtype=np.uint8)
+        sol_inc = ctypes.c_int64()
+        k = self._lib.tts_nq_generate(
+            self.N, _i32(pdepth), _u8(pboard), count, _u8(lab),
+            _i32(cdepth), _u8(cboard), ctypes.byref(sol_inc),
+        )
+        children = {"depth": cdepth[:k].copy(), "board": cboard[:k].copy()}
+        return children, int(k), sol_inc.value
+
+
+class NativePFSP:
+    """Native host primitives for one PFSP (instance, lb) configuration.
+
+    Owns an opaque context holding the instance tables built by the Python
+    oracle (`bounds.py`), so every tier shares bit-identical tables.
+    """
+
+    _LB_KINDS = {"lb1": 0, "lb1_d": 1, "lb2": 2}
+
+    def __init__(self, lib: ctypes.CDLL, lb1_data, lb2_data, lb: str):
+        self._lib = lib
+        self.jobs = int(lb1_data.jobs)
+        self.machines = int(lb1_data.machines)
+        # Keep the table arrays alive for the context's lifetime.
+        self._tables = (
+            np.ascontiguousarray(lb1_data.p_times, dtype=np.int32),
+            np.ascontiguousarray(lb1_data.min_heads, dtype=np.int32),
+            np.ascontiguousarray(lb1_data.min_tails, dtype=np.int32),
+            np.ascontiguousarray(lb2_data.pairs, dtype=np.int32),
+            np.ascontiguousarray(lb2_data.lags, dtype=np.int32),
+            np.ascontiguousarray(lb2_data.johnson_schedules, dtype=np.int32),
+        )
+        ptm, mh, mt, pairs, lags, jsched = self._tables
+        self._ctx = lib.tts_pfsp_new(
+            self.jobs, self.machines, self._LB_KINDS[lb],
+            _i32(ptm), _i32(mh), _i32(mt),
+            pairs.shape[0], _i32(pairs), _i32(lags), _i32(jsched),
+        )
+
+    def __del__(self):
+        ctx = getattr(self, "_ctx", None)
+        if ctx:
+            self._lib.tts_pfsp_free(ctx)
+            self._ctx = None
+
+    def sequential(self, best: int) -> tuple[int, int, int]:
+        tree = ctypes.c_int64()
+        sol = ctypes.c_int64()
+        best_out = ctypes.c_int32()
+        self._lib.tts_pfsp_sequential(
+            self._ctx, best, ctypes.byref(tree), ctypes.byref(sol),
+            ctypes.byref(best_out),
+        )
+        return tree.value, sol.value, best_out.value
+
+    def warmup(self, batch: dict, best: int, target: int):
+        size_in = batch["depth"].shape[0]
+        cap = max(size_in, target + self.jobs)
+        depth = np.zeros(cap, dtype=np.int32)
+        limit1 = np.zeros(cap, dtype=np.int32)
+        prmu = np.zeros((cap, self.jobs), dtype=np.int32)
+        depth[:size_in] = batch["depth"]
+        limit1[:size_in] = batch["limit1"]
+        prmu[:size_in] = batch["prmu"]
+        tree = ctypes.c_int64()
+        sol = ctypes.c_int64()
+        best_io = ctypes.c_int32(best)
+        out = self._lib.tts_pfsp_warmup(
+            self._ctx, target, _i32(depth), _i32(limit1), _i32(prmu), size_in,
+            ctypes.byref(tree), ctypes.byref(sol), ctypes.byref(best_io),
+        )
+        frontier = {
+            "depth": depth[:out].copy(),
+            "limit1": limit1[:out].copy(),
+            "prmu": prmu[:out].copy(),
+        }
+        return frontier, tree.value, sol.value, best_io.value
+
+    def drain(self, batch: dict, best: int) -> tuple[int, int, int]:
+        size = batch["depth"].shape[0]
+        depth = np.ascontiguousarray(batch["depth"], dtype=np.int32)
+        limit1 = np.ascontiguousarray(batch["limit1"], dtype=np.int32)
+        prmu = np.ascontiguousarray(batch["prmu"], dtype=np.int32)
+        tree = ctypes.c_int64()
+        sol = ctypes.c_int64()
+        best_io = ctypes.c_int32(best)
+        self._lib.tts_pfsp_drain(
+            self._ctx, _i32(depth), _i32(limit1), _i32(prmu), size,
+            ctypes.byref(tree), ctypes.byref(sol), ctypes.byref(best_io),
+        )
+        return tree.value, sol.value, best_io.value
+
+    def generate_children(
+        self, parents: dict, count: int, bounds: np.ndarray, best: int
+    ):
+        n = self.jobs
+        pdepth = np.ascontiguousarray(parents["depth"][:count], dtype=np.int32)
+        plimit1 = np.ascontiguousarray(parents["limit1"][:count], dtype=np.int32)
+        pprmu = np.ascontiguousarray(parents["prmu"][:count], dtype=np.int32)
+        bnds = np.ascontiguousarray(bounds[:count], dtype=np.int32)
+        cap = count * n
+        cdepth = np.zeros(cap, dtype=np.int32)
+        climit1 = np.zeros(cap, dtype=np.int32)
+        cprmu = np.zeros((cap, n), dtype=np.int32)
+        sol_inc = ctypes.c_int64()
+        best_io = ctypes.c_int32(best)
+        k = self._lib.tts_pfsp_generate(
+            self._ctx, _i32(pdepth), _i32(plimit1), _i32(pprmu), count,
+            _i32(bnds), _i32(cdepth), _i32(climit1), _i32(cprmu),
+            ctypes.byref(sol_inc), ctypes.byref(best_io),
+        )
+        children = {
+            "depth": cdepth[:k].copy(),
+            "limit1": climit1[:k].copy(),
+            "prmu": cprmu[:k].copy(),
+        }
+        return children, int(k), sol_inc.value, best_io.value

@@ -157,6 +157,12 @@ class SoAPool:
             for name, arr in self.data.items()
         }
 
+    def reset_from(self, batch: dict) -> None:
+        """Replace the whole contents with ``batch`` (native-runtime handoff)."""
+        self.front = 0
+        self.size = 0
+        self.push_back_bulk(batch)
+
 
 class ParallelSoAPool(SoAPool):
     """Lock-protected pool for the multi-device runtime

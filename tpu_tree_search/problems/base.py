@@ -76,6 +76,38 @@ class Problem:
         """Vectorized host-side prune/branch from device results."""
         raise NotImplementedError
 
+    # -- native host runtime (csrc/tts_native.cpp) -------------------------
+    #
+    # Problems may provide C++ fast paths for the host-side phases by
+    # overriding ``_make_native``; every ``native_*`` hook returns None when
+    # the native library is unavailable (TTS_NATIVE=0 or no toolchain) and
+    # the caller falls back to the Python path. The Python implementations
+    # stay the semantic oracles.
+
+    def _make_native(self, lib):
+        """Build this problem's native runtime from the loaded library."""
+        return None
+
+    def _native(self):
+        if not hasattr(self, "_native_rt"):
+            from .. import native
+
+            lib = native.load()
+            self._native_rt = self._make_native(lib) if lib else None
+        return self._native_rt
+
+    def native_sequential(self, best: int):
+        """Full sequential search -> (tree, sol, best) or None."""
+        return None
+
+    def native_warmup(self, batch: NodeBatch, best: int, target: int):
+        """BFS warm-up -> (frontier_batch, tree, sol, best) or None."""
+        return None
+
+    def native_drain(self, batch: NodeBatch, best: int):
+        """DFS a frontier to completion -> (tree, sol, best) or None."""
+        return None
+
     # -- helpers -----------------------------------------------------------
 
     def empty_batch(self, capacity: int) -> NodeBatch:

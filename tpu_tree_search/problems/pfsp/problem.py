@@ -145,6 +145,31 @@ class PFSPProblem(Problem):
             ),
         }
 
+    # -- native host runtime -----------------------------------------------
+
+    def _make_native(self, lib):
+        from ... import native
+
+        return native.NativePFSP(lib, self.lb1_data, self.lb2_data, self.lb)
+
+    def native_sequential(self, best: int):
+        nat = self._native()
+        if nat is None:
+            return None
+        return nat.sequential(best)
+
+    def native_warmup(self, batch: NodeBatch, best: int, target: int):
+        nat = self._native()
+        if nat is None:
+            return None
+        return nat.warmup(batch, best, target)
+
+    def native_drain(self, batch: NodeBatch, best: int):
+        nat = self._native()
+        if nat is None:
+            return None
+        return nat.drain(batch, best)
+
     # -- device path -------------------------------------------------------
 
     def make_device_evaluator(self):
@@ -170,6 +195,12 @@ class PFSPProblem(Problem):
         in-chunk updates whenever ub=1 (the incumbent never improves), and a
         valid B&B relaxation otherwise (SURVEY.md §2.4.4 lazy UB).
         """
+        nat = self._native()
+        if nat is not None:
+            children, tree_inc, sol_inc, best = nat.generate_children(
+                parents, count, np.asarray(results), best
+            )
+            return DecomposeResult(children, tree_inc, sol_inc, best)
         jobs = self.jobs
         depth = parents["depth"][:count].astype(np.int64)
         limit1 = parents["limit1"][:count].astype(np.int64)
