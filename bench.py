@@ -10,6 +10,10 @@ BASELINE.json), so ``vs_baseline`` is reported against REFERENCE_NODES_PER_SEC
 below — the first recorded value of this same benchmark on this hardware
 (round 1); later rounds show relative progress.
 
+Engine: the device-resident tier (pool in HBM, chunk cycles inside one
+jitted while-loop) — ~10x the classic host-offload loop on remote-TPU
+runtimes because it removes the per-chunk host round trip.
+
 Runs on whatever platform jax picks (real TPU under the driver). Set
 JAX_PLATFORMS=cpu to smoke-test on CPU.
 """
@@ -28,18 +32,18 @@ GOLDEN = {"tree": 2_573_652, "sol": 2648, "makespan": 1377}
 
 
 def main() -> int:
-    from tpu_tree_search.engine.device import device_search
+    from tpu_tree_search.engine.resident import resident_search
     from tpu_tree_search.problems import PFSPProblem
 
     problem = PFSPProblem(inst=14, lb="lb1", ub=1)
 
-    # Throwaway warm-up search: compiles every bucket shape the real run will
-    # hit (first TPU compile is ~20-40s per shape), so the measured run below
-    # reflects steady-state throughput.
-    device_search(problem, m=25, M=65536)
+    # Throwaway warm-up search compiles the device-resident while-loop
+    # program (~30s first time on TPU); the measured run below reflects
+    # steady-state throughput.
+    resident_search(problem, m=25, M=65536)
 
     t0 = time.time()
-    res = device_search(problem, m=25, M=65536)
+    res = resident_search(problem, m=25, M=65536)
     elapsed = time.time() - t0
 
     device_phase = res.phases[1].seconds if len(res.phases) > 1 else res.elapsed

@@ -1,0 +1,65 @@
+"""Device-resident engine: parity against the sequential anchor.
+
+The resident tier must reproduce the sequential tier's exploredTree /
+exploredSol exactly whenever the incumbent is fixed (N-Queens never prunes;
+PFSP with a preloaded optimal incumbent never improves it) — the same
+determinism invariant the reference relies on across its tiers
+(SURVEY.md §4.2). With an improving incumbent (ub=0) the resident tier is a
+valid B&B relaxation: it must find the same optimum.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.engine.sequential import sequential_search
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard
+
+
+def test_nqueens_parity():
+    prob = NQueensProblem(N=10)
+    seq = sequential_search(prob)
+    res = resident_search(prob, m=8, M=256, K=64)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree,
+        seq.explored_sol,
+    )
+
+
+def test_nqueens_overflow_fallback():
+    # Tiny capacity forces the capacity-stall path (host offload cycles) and
+    # the in-step survivor-budget overflow branch; counts must not change.
+    prob = NQueensProblem(N=11)
+    seq = sequential_search(prob)
+    res = resident_search(prob, m=8, M=64, K=16, capacity=6000)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree,
+        seq.explored_sol,
+    )
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb1_d", "lb2"])
+def test_pfsp_fixed_incumbent_parity(lb):
+    ptm = taillard.reduced_instance(14, jobs=10, machines=5)
+    # Establish the optimum with the sequential engine, then run both tiers
+    # with that fixed incumbent: counts must match node-for-node.
+    opt = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm)).best
+    seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm), initial_best=opt)
+    res = resident_search(
+        PFSPProblem(lb=lb, ub=0, p_times=ptm), m=8, M=256, K=64, initial_best=opt
+    )
+    assert res.best == seq.best == opt
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree,
+        seq.explored_sol,
+    )
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb2"])
+def test_pfsp_improving_incumbent_finds_optimum(lb):
+    ptm = taillard.reduced_instance(7, jobs=9, machines=6)
+    seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm))
+    res = resident_search(PFSPProblem(lb=lb, ub=0, p_times=ptm), m=8, M=128, K=32)
+    assert res.best == seq.best

@@ -1,0 +1,523 @@
+"""Device-resident search engine — the fully TPU-native tier.
+
+The reference's offload loop round-trips host<->device once per chunk
+(`pfsp_gpu_chpl.chpl:373-396`: H2D parents, kernel, D2H bounds, host
+prune/branch).  On TPU the dominant cost of that design is not the kernel but
+the dispatch + transfer latency of every cycle (hundreds of ms over a remote
+runtime, vs sub-ms of device compute for a 64k-node chunk).  This engine
+inverts the ownership: the **pool itself lives in HBM** as fixed-capacity SoA
+arrays, and one jitted step advances the search by up to K chunk cycles
+inside a `lax.while_loop` — pop, evaluate, prune, compact, push are all
+device ops; the host only re-dispatches the step and reads back four scalars
+every K cycles.
+
+Semantics per cycle are exactly the reference's chunk cycle (SURVEY.md
+Appendix A):
+
+  * pop the back `cnt = min(size, M)` nodes, only while `size >= m`;
+  * evaluate all `cnt * child_slots` children in one batch;
+  * PFSP: a child with depth == jobs is a leaf -> exploredSol++, folds the
+    incumbent with a min; a non-leaf child is pushed iff `bound < best`
+    strictly, counting exploredTree (`pfsp_chpl.chpl:100-111`);
+  * N-Queens: a parent popped at depth == N counts one solution; safe
+    children are always pushed (no pruning), depth-N leaves included
+    (`nqueens_chpl.chpl:70-89`).
+
+The push is a masked scatter: survivors are ranked with a prefix sum and
+scattered to `pool[size + rank]` (out-of-bounds destinations dropped), the
+device-side equivalent of the prune+compact improvement suggested in
+SURVEY.md §7.3 ("move prune+compact onto device").
+
+Capacity safety: the loop only runs a cycle while `size + M*child_slots <=
+capacity`, so a cycle can never lose children.  If the pool outgrows that
+headroom the step returns early and the host falls back to classic offload
+cycles (pop via the host pool) until the frontier shrinks — correctness never
+depends on the capacity heuristic.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import numpy as np
+
+from ..pool import SoAPool
+from ..problems.base import INF_BOUND, Problem
+from ..problems.nqueens import NQueensProblem
+from ..problems.pfsp.problem import PFSPProblem
+from .device import DeviceOffloader, bucket_size, warmup
+from .results import Diagnostics, PhaseStats, SearchResult
+
+
+def _pool_int_dtype(n: int):
+    import jax.numpy as jnp
+
+    if n <= 127:
+        return jnp.int8
+    if n <= 32767:
+        return jnp.int16
+    return jnp.int32
+
+
+def _swap_children(chunk_vals, depth):
+    """All single-swap children of each parent row.
+
+    chunk_vals: (M, n) permutation rows; depth: (M,) swap position.
+    Returns (M, n, n): row (i, k) = parent i with positions depth_i and k
+    swapped (identity when k == depth_i) — the branching rule shared by both
+    problems (`pfsp_chpl.chpl:91-96`, `nqueens_chpl.chpl:78-87`).
+    """
+    import jax.numpy as jnp
+
+    # A child differs from its parent at exactly two positions, so the cube
+    # is three elementwise selects over (M, n, n) — no gather (a full
+    # take_along_axis over the cube costs ~40x more on TPU).
+    iota = jnp.arange(chunk_vals.shape[1], dtype=jnp.int32)[None, None, :]
+    kcol = iota.transpose(0, 2, 1)  # (1, n, 1)
+    d = depth[:, None, None]
+    val_at_k = chunk_vals[:, :, None]  # parent[i, k] per (i, k, *)
+    val_at_d = jnp.take_along_axis(chunk_vals, depth[:, None], axis=1)[:, :, None]
+    base = chunk_vals[:, None, :]  # parent[i, *, j]
+    return jnp.where(iota == d, val_at_k, jnp.where(iota == kcol, val_at_d, base))
+
+
+def _compact_ids(keep, S: int):
+    """Stream-compaction indices of the surviving (parent, slot) pairs.
+
+    keep: (M, n) bool. Returns (ids, tree_inc): ids (S,) int32 such that
+    ids[s] = flat index i*n+k of the s-th survivor in (parent, slot) order
+    for s < tree_inc (the reference's child push order,
+    `pfsp_gpu_chpl.chpl:276-298`). Ranks are computed hierarchically (lane
+    scan + per-parent prefix) — much cheaper than a flat M*n cumsum — and
+    the inverse permutation is one scatter of int32 ids, not of node rows.
+    """
+    import jax.numpy as jnp
+
+    M, n = keep.shape
+    cnt = jnp.sum(keep, axis=1, dtype=jnp.int32)  # (M,)
+    offs = jnp.cumsum(cnt) - cnt  # exclusive prefix
+    lane = jnp.cumsum(keep.astype(jnp.int32), axis=1) - keep
+    ranks = offs[:, None] + lane  # (M, n)
+    tree_inc = offs[-1] + cnt[-1]
+    Mn = M * n
+    flat_idx = jnp.arange(Mn, dtype=jnp.int32)
+    # Non-survivors get distinct out-of-bounds destinations so the scatter
+    # is genuinely unique-indexed (mode="drop" discards them).
+    dst = jnp.where(keep.reshape(Mn), ranks.reshape(Mn), S + flat_idx)
+    ids = (
+        jnp.zeros((S,), jnp.int32)
+        .at[dst]
+        .set(flat_idx, mode="drop", unique_indices=True)
+    )
+    return ids, tree_inc
+
+
+class _ResidentProgram:
+    """Compiled device-resident step for one (problem, m, M, K, capacity).
+
+    Pool layout (both problems): ``vals`` (C, n) — the permutation rows —
+    plus one scalar ``aux`` column (C,) (PFSP: limit1; N-Queens: depth).
+    Subclasses provide the chunk evaluator and the swap position.
+    """
+
+    def __init__(self, problem, m: int, M: int, K: int, capacity: int, device):
+        import jax
+
+        self.problem = problem
+        self.m = m
+        self.M = M
+        self.capacity = capacity
+        n = problem.child_slots
+        # Counter headroom: every step call accumulates at most K*M*n into
+        # int32 counters.
+        self.K = max(1, min(K, (2**31 - 1) // max(1, M * n)))
+        self.device = device if device is not None else jax.devices()[0]
+        self._step = self._build()
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        n = self.problem.child_slots
+        m, M, K, C = self.m, self.M, self.K, self.capacity
+        Mn = M * n
+        # The while condition reserves exactly Mn rows of headroom, so the
+        # budget must never exceed Mn (a small M would otherwise make the
+        # small-path write overrun the reservation and corrupt live rows).
+        S = min(max(64 * n, Mn // self.survivor_budget_div), Mn)
+        vals_dt = self.pool_fields[0][1]
+        aux_dt = self.pool_fields[1][1]
+        evaluate = self._make_eval()
+        swap_of = self._swap_pos
+
+        def body(carry):
+            pool_vals, pool_aux, size, best, tree, sol, cycles = carry
+            cnt = jnp.minimum(size, M)
+            start = size - cnt
+            start2 = jnp.clip(start, 0, C - M)
+            idx = start2 + jnp.arange(M, dtype=jnp.int32)
+            valid = (idx >= start) & (idx < size)
+            vals8_c = lax.dynamic_slice(pool_vals, (start2, 0), (M, n))
+            vals_c = vals8_c.astype(jnp.int32)
+            aux_c = lax.dynamic_slice(pool_aux, (start2,), (M,)).astype(jnp.int32)
+            size = size - cnt
+
+            keep, sol_inc, best = evaluate(vals_c, aux_c, valid, best)
+            d = swap_of(aux_c)  # (M,) swap position per parent
+
+            ids, tree_inc = _compact_ids(keep, S)
+            fits = tree_inc <= S
+
+            def small(pool_vals, pool_aux):
+                # Gather only the survivor budget; rows beyond tree_inc are
+                # garbage past the new size (dead by the pool contract).
+                pi = ids // n
+                kj = ids % n
+                rows = vals8_c[pi]  # (S, n) narrow-dtype gather
+                dp = d[pi]
+                iota = jnp.arange(n, dtype=jnp.int32)[None, :]
+                v_k = jnp.take_along_axis(rows, kj[:, None], axis=1)
+                v_d = jnp.take_along_axis(rows, dp[:, None], axis=1)
+                crows = jnp.where(
+                    iota == dp[:, None],
+                    v_k,
+                    jnp.where(iota == kj[:, None], v_d, rows),
+                )
+                pool_vals = lax.dynamic_update_slice(
+                    pool_vals, crows, (size, jnp.int32(0))
+                )
+                pool_aux = lax.dynamic_update_slice(
+                    pool_aux, (aux_c[pi] + 1).astype(aux_dt), (size,)
+                )
+                return pool_vals, pool_aux
+
+            def big(pool_vals, pool_aux):
+                # Overflow fallback: full masked row scatter (rare — only
+                # when a chunk keeps more than S children).
+                child = _swap_children(vals_c, d).astype(vals_dt)
+                lane = jnp.cumsum(keep.astype(jnp.int32), axis=1) - keep
+                cntp = jnp.sum(keep, axis=1, dtype=jnp.int32)
+                ranks = (jnp.cumsum(cntp) - cntp)[:, None] + lane
+                dest = jnp.where(keep.reshape(Mn), size + ranks.reshape(Mn), C)
+                pool_vals = pool_vals.at[dest].set(
+                    child.reshape(Mn, n), mode="drop"
+                )
+                caux = jnp.repeat(aux_c + 1, n).astype(aux_dt)
+                pool_aux = pool_aux.at[dest].set(caux, mode="drop")
+                return pool_vals, pool_aux
+
+            pool_vals, pool_aux = lax.cond(fits, small, big, pool_vals, pool_aux)
+            size = size + tree_inc
+            return (
+                pool_vals, pool_aux, size, best,
+                tree + tree_inc, sol + sol_inc, cycles + 1,
+            )
+
+        def cond(carry):
+            _, _, size, _, _, _, cycles = carry
+            return (size >= m) & (size + Mn <= C) & (cycles < K)
+
+        def step(pool_vals, pool_aux, size, best):
+            zero = jnp.int32(0)
+            return lax.while_loop(
+                cond, body, (pool_vals, pool_aux, size, best, zero, zero, zero)
+            )
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # -- state layout: (pool..., size, best, tree_inc, sol_inc, cycles) ----
+
+    def init_state(self, frontier: dict, best: int):
+        import jax
+        import jax.numpy as jnp
+
+        C = self.capacity
+        k = frontier[self.size_field].shape[0] if frontier else 0
+        with jax.default_device(self.device):
+            pools = []
+            for name, dtype, shape in self.pool_fields:
+                buf = jnp.zeros((C,) + shape, dtype=dtype)
+                if k:
+                    rows = jnp.asarray(frontier[name]).astype(dtype)
+                    buf = buf.at[:k].set(rows)
+                pools.append(buf)
+            return (
+                *pools,
+                jnp.int32(k),
+                jnp.int32(best),
+            )
+
+    def step(self, state):
+        """One dispatch: up to K device-side chunk cycles."""
+        return self._step(*state)
+
+    def read(self, out):
+        """Blocks on the step result; returns (state, tree, sol, cycles)."""
+        *state, tree, sol, cycles = out
+        return tuple(state), int(tree), int(sol), int(cycles)
+
+    def residual(self, state) -> tuple[dict, int, int]:
+        """Downloads the remaining pool -> (host NodeBatch, size, best)."""
+        *pools, size, best = state
+        size = int(size)
+        best = int(best)
+        # Static-shape slice: residual after a completed run is < m nodes, so
+        # one padded transfer; the overflow fallback passes larger sizes.
+        batch = {}
+        fields = self.problem.node_fields()
+        for (name, _, _), buf in zip(self.pool_fields, pools):
+            host = np.asarray(buf[: max(size, 1)])[:size]
+            batch[name] = host.astype(fields[name][1])
+        return self.derive_fields(batch), size, best
+
+
+class _PFSPResident(_ResidentProgram):
+    size_field = "prmu"
+    # Deep PFSP chunks prune heavily (closed slots + bound cuts); comfortably
+    # under a quarter of the slot grid in practice.
+    survivor_budget_div = 4
+
+    def __init__(self, problem: PFSPProblem, *a, **kw):
+        import jax.numpy as jnp
+
+        n = problem.jobs
+        self._dt = _pool_int_dtype(n)
+        self.pool_fields = (
+            ("prmu", self._dt, (n,)),
+            ("limit1", jnp.int8 if n <= 127 else jnp.int32, ()),
+        )
+        super().__init__(problem, *a, **kw)
+
+    def derive_fields(self, batch: dict) -> dict:
+        # depth == limit1 + 1 for every node the engine ever pushes (forward
+        # branching; the root depth=0/limit1=-1 satisfies it too).
+        batch["depth"] = (batch["limit1"] + 1).astype(np.int32)
+        return batch
+
+    def _swap_pos(self, aux_c):
+        return aux_c + 1  # parent depth = limit1 + 1
+
+    def _make_eval(self):
+        import jax.numpy as jnp
+
+        from ..ops import pfsp_device as P
+
+        prob = self.problem
+        t = getattr(prob, "_device_tables", None)
+        if t is None:
+            t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+            prob._device_tables = t
+        lb = prob.lb
+        n = prob.jobs
+
+        def evaluate(prmu_c, limit1_c, valid, best):
+            if lb == "lb1":
+                bounds = P._lb1_chunk(prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails)
+            elif lb == "lb1_d":
+                bounds = P._lb1_d_chunk(prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails)
+            else:
+                bounds = P._lb2_chunk(
+                    prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails,
+                    t.pairs, t.lags, t.johnson_schedules,
+                )
+            pdepth = limit1_c + 1
+            kk = jnp.arange(n, dtype=jnp.int32)[None, :]
+            open_ = (kk >= pdepth[:, None]) & valid[:, None]
+            leaf = open_ & ((pdepth[:, None] + 1) == n)
+            sol_inc = jnp.sum(leaf, dtype=jnp.int32)
+            # Leaf makespans fold into the incumbent before the prune test,
+            # exactly like the host generate_children (`pfsp_chpl.chpl:100-111`).
+            best = jnp.minimum(best, jnp.min(jnp.where(leaf, bounds, INF_BOUND)))
+            keep = open_ & (~leaf) & (bounds < best)
+            return keep, sol_inc, best
+
+        return evaluate
+
+
+class _NQueensResident(_ResidentProgram):
+    size_field = "board"
+    # No pruning: every safe slot survives, so give the compactor half the
+    # slot grid before it falls back to the full scatter.
+    survivor_budget_div = 2
+
+    def __init__(self, problem: NQueensProblem, *a, **kw):
+        import jax.numpy as jnp
+
+        self.pool_fields = (
+            ("board", jnp.uint8, (problem.N,)),
+            ("depth", jnp.int8 if problem.N <= 127 else jnp.int32, ()),
+        )
+        super().__init__(problem, *a, **kw)
+
+    def derive_fields(self, batch: dict) -> dict:
+        return batch
+
+    def _swap_pos(self, aux_c):
+        return aux_c  # swap position is the parent depth itself
+
+    def _make_eval(self):
+        import jax.numpy as jnp
+
+        from ..ops import nqueens_device
+
+        N = self.problem.N
+        core = nqueens_device.make_core(N, self.problem.g)
+
+        def evaluate(board_c, depth_c, valid, best):
+            # A popped node at depth == N is a solution (`nqueens_chpl.chpl:74`).
+            sol_inc = jnp.sum(valid & (depth_c == N), dtype=jnp.int32)
+            labels = core(board_c, depth_c).astype(bool)  # k >= depth folded in
+            keep = labels & valid[:, None] & (depth_c < N)[:, None]
+            return keep, sol_inc, best
+
+        return evaluate
+
+
+def _make_program(problem: Problem, m, M, K, capacity, device) -> _ResidentProgram:
+    # One compiled program per (problem, config): rebuilding the jit closure
+    # would recompile the whole while-loop program on every search (~30 s on
+    # TPU), so programs are cached on the problem instance.
+    cache = getattr(problem, "_resident_programs", None)
+    if cache is None:
+        cache = problem._resident_programs = {}
+    key = (m, M, K, capacity, id(device))
+    if key in cache:
+        return cache[key]
+    if isinstance(problem, PFSPProblem):
+        prog = _PFSPResident(problem, m, M, K, capacity, device)
+    elif isinstance(problem, NQueensProblem):
+        prog = _NQueensResident(problem, m, M, K, capacity, device)
+    else:
+        raise TypeError(f"no resident program for {type(problem).__name__}")
+    cache[key] = prog
+    return prog
+
+
+def default_capacity(M: int, child_slots: int, node_bytes: int) -> int:
+    """Pool capacity heuristic: at least two full chunk fan-outs of headroom,
+    capped by a ~1 GiB HBM budget. Correctness never depends on it (overflow
+    falls back to host offload cycles)."""
+    want = max(2 * M * child_slots, 1 << 21)
+    budget = (1 << 30) // max(1, node_bytes)
+    return max(4 * M, min(want, budget))
+
+
+def resident_search(
+    problem: Problem,
+    m: int = 25,
+    M: int = 65536,
+    K: int = 4096,
+    capacity: int | None = None,
+    device=None,
+    initial_best: int | None = None,
+    warmup_target: int | None = None,
+) -> SearchResult:
+    """3-phase search with a device-resident hot loop.
+
+    Phase 1 (host warm-up) and phase 3 (host drain) are identical to
+    `device_search`; phase 2 runs on-device in blocks of up to K chunk
+    cycles per dispatch.
+    """
+    best = (
+        initial_best
+        if initial_best is not None
+        else getattr(problem, "initial_ub", INF_BOUND)
+    )
+    n = problem.child_slots
+    if capacity is None:
+        fields = problem.node_fields()
+        node_bytes = sum(
+            int(np.prod(shape, dtype=np.int64)) * dt.itemsize + 4
+            for shape, dt in fields.values()
+        )
+        capacity = default_capacity(M, n, node_bytes)
+    # The device loop needs one chunk fan-out of headroom to run at all.
+    M = min(M, max(64, (capacity // 2) // n))
+
+    from ..problems.base import index_batch
+
+    pool = SoAPool(problem.node_fields())
+    pool.push_back(index_batch(problem.root(), 0))
+
+    diagnostics = Diagnostics()
+    phases: list[PhaseStats] = []
+    t0 = time.perf_counter()
+
+    # -- phase 1: host warm-up --------------------------------------------
+    target = m if warmup_target is None else warmup_target
+    tree1, sol1, best = warmup(problem, pool, best, target)
+    t1 = time.perf_counter()
+    phases.append(PhaseStats(t1 - t0, tree1, sol1))
+
+    # -- phase 2: device-resident loop ------------------------------------
+    program = _make_program(problem, m, M, K, capacity, device)
+    state = program.init_state(pool.as_batch(), best)
+    pool.clear()
+    diagnostics.host_to_device += 1
+    tree2 = 0
+    sol2 = 0
+    offloader = None
+    while True:
+        out = program.step(state)
+        state, tree_inc, sol_inc, cycles = program.read(out)
+        tree2 += tree_inc
+        sol2 += sol_inc
+        diagnostics.kernel_launches += cycles
+        size = int(state[-2])
+        best = int(state[-1])
+        if size < m:
+            break
+        if cycles == 0:
+            # Capacity stall: pool too full for another device fan-out. Run
+            # classic offload cycles through a host pool until there is
+            # headroom again (rare; guarantees progress at any capacity).
+            batch, size, best = program.residual(state)
+            diagnostics.device_to_host += 1
+            pool.reset_from(batch)
+            if offloader is None:
+                offloader = DeviceOffloader(problem, program.device)
+            chunk_buf = problem.empty_batch(M)
+            while pool.size >= m and pool.size + M * n > capacity:
+                count = pool.pop_back_bulk(m, M, chunk_buf)
+                if count == 0:
+                    break
+                bucket = bucket_size(count, m, M)
+                snapshot = {k: v[:count].copy() for k, v in chunk_buf.items()}
+                res_dev = offloader.dispatch(snapshot, count, bucket, best)
+                res = problem.generate_children(
+                    snapshot, count, offloader.collect(res_dev), best
+                )
+                tree2 += res.tree_inc
+                sol2 += res.sol_inc
+                best = res.best
+                pool.push_back_bulk(res.children)
+            diagnostics.kernel_launches += offloader.diagnostics.kernel_launches
+            diagnostics.host_to_device += offloader.diagnostics.host_to_device
+            diagnostics.device_to_host += offloader.diagnostics.device_to_host
+            offloader.diagnostics = Diagnostics()
+            state = program.init_state(pool.as_batch(), best)
+            pool.clear()
+            diagnostics.host_to_device += 1
+    batch, size, best = program.residual(state)
+    diagnostics.device_to_host += 1
+    pool.reset_from(batch)
+    t2 = time.perf_counter()
+    phases.append(PhaseStats(t2 - t1, tree2, sol2))
+
+    # -- phase 3: host drain ----------------------------------------------
+    from .device import drain
+
+    tree3, sol3, best = drain(problem, pool, best)
+    t3 = time.perf_counter()
+    phases.append(PhaseStats(t3 - t2, tree3, sol3))
+
+    return SearchResult(
+        explored_tree=tree1 + tree2 + tree3,
+        explored_sol=sol1 + sol2 + sol3,
+        best=best,
+        elapsed=t3 - t0,
+        phases=phases,
+        diagnostics=diagnostics,
+    )

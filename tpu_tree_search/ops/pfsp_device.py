@@ -68,6 +68,26 @@ def _machine_bound_from_parts(front, back, remain):
     return lb
 
 
+def gather_ptimes(prmu, ptm_t):
+    """Per-position processing times ``ptg[b, i, :] = ptm_t[prmu[b, i]]``.
+
+    For small job counts this is a one-hot f32 matmul instead of a gather:
+    the MXU evaluates it far faster than TPU dynamic gathers, and it is exact
+    (one-hot rows select a single int value, and ints < 2^24 are exact in
+    f32). Larger instances fall back to the gather (the (B, n, n) one-hot
+    would dominate memory).
+    """
+    n = prmu.shape[-1]
+    if n <= 64:
+        oh = jax.nn.one_hot(prmu, n, dtype=jnp.float32)  # (B, n, n)
+        return jnp.einsum(
+            "bkj,jm->bkm", oh, ptm_t.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,  # TPU default is bf16-pass
+        ).astype(jnp.int32)
+    return ptm_t[prmu]
+
+
 def _parent_state(prmu, limit1, ptm_t, min_heads):
     """Shared per-parent precomputation for a chunk.
 
@@ -81,7 +101,7 @@ def _parent_state(prmu, limit1, ptm_t, min_heads):
       unsched: (B, n) 1.0 where position is free (pos >= limit1 + 1)
     """
     B, n = prmu.shape
-    ptg = ptm_t[prmu]  # (B, n, m)
+    ptg = gather_ptimes(prmu, ptm_t)  # (B, n, m)
     pos = jnp.arange(n, dtype=jnp.int32)[None, :]
     unsched = (pos >= limit1[:, None] + 1).astype(jnp.int32)  # (B, n)
 

@@ -159,9 +159,12 @@ class SoAPool:
 
     def reset_from(self, batch: dict) -> None:
         """Replace the whole contents with ``batch`` (native-runtime handoff)."""
+        self.clear()
+        self.push_back_bulk(batch)
+
+    def clear(self) -> None:
         self.front = 0
         self.size = 0
-        self.push_back_bulk(batch)
 
 
 class ParallelSoAPool(SoAPool):
