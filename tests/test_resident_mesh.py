@@ -1,0 +1,78 @@
+"""Mesh-resident (SPMD) tier: parity on a virtual 8-device mesh.
+
+Counting must be identical to the sequential anchor whenever the incumbent
+is fixed — diffusion balancing only permutes visit order (SURVEY.md §4.2
+cross-tier determinism); with an improving incumbent the tier must find the
+same optimum (pmin all-reduce correctness).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine.sequential import sequential_search
+from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard
+
+
+def test_nqueens_parity_and_balance():
+    prob = NQueensProblem(N=10)
+    seq = sequential_search(prob)
+    res = mesh_resident_search(prob, m=8, M=128, K=8, rounds=2)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree,
+        seq.explored_sol,
+    )
+    # The diffusion balancer must spread the tree across shards: no single
+    # shard may own (almost) everything on an 8-way mesh.
+    per = np.asarray(res.per_worker_tree)
+    if per.size > 1:
+        assert per.max() < 0.8 * per.sum()
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb2"])
+def test_pfsp_fixed_incumbent_parity(lb):
+    ptm = taillard.reduced_instance(14, jobs=10, machines=5)
+    opt = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm)).best
+    seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm), initial_best=opt)
+    res = mesh_resident_search(
+        PFSPProblem(lb=lb, ub=0, p_times=ptm), m=8, M=128, K=8, initial_best=opt
+    )
+    assert res.best == opt
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree,
+        seq.explored_sol,
+    )
+
+
+def test_pfsp_improving_incumbent_pmin():
+    ptm = taillard.reduced_instance(7, jobs=9, machines=6)
+    seq = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm))
+    res = mesh_resident_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm), m=8, M=128, K=8)
+    assert res.best == seq.best
+
+
+def test_saturation_fallback():
+    # A capacity far too small for the frontier forces the host-offload
+    # saturation fallback; counts must survive the round trips.
+    prob = NQueensProblem(N=11)
+    seq = sequential_search(prob)
+    res = mesh_resident_search(prob, m=8, M=64, K=4, rounds=1, capacity=3000)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree,
+        seq.explored_sol,
+    )
+
+
+def test_single_device_mesh_degenerates():
+    import jax
+
+    prob = NQueensProblem(N=9)
+    seq = sequential_search(prob)
+    res = mesh_resident_search(prob, m=8, M=128, devices=jax.devices()[:1])
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree,
+        seq.explored_sol,
+    )

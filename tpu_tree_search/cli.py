@@ -27,13 +27,30 @@ def build_parser() -> argparse.ArgumentParser:
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument(
         "--tier",
-        choices=["seq", "device", "multi", "dist"],
+        choices=["seq", "device", "mesh", "multi", "dist"],
         default="seq",
-        help="scaling tier (sequential / single-device / multi-device / multi-host)",
+        help=(
+            "scaling tier: sequential / single-device / SPMD device mesh / "
+            "multi-device host threads / multi-host"
+        ),
+    )
+    common.add_argument(
+        "--engine",
+        choices=["resident", "offload"],
+        default="resident",
+        help=(
+            "device tier engine: resident = pool in HBM, chunk cycles inside "
+            "one jitted loop (fast); offload = per-chunk host round trip "
+            "(the reference's structure)"
+        ),
     )
     common.add_argument("--m", type=int, default=25, help="minimum chunk size")
     common.add_argument("--M", type=int, default=50000, help="maximum chunk size")
-    common.add_argument("--D", type=int, default=1, help="number of devices (multi tier)")
+    common.add_argument(
+        "--D", type=int, default=None,
+        help="number of devices/shards (mesh, multi, dist tiers); "
+        "default: all local devices",
+    )
     common.add_argument("--stats-file", type=str, default=None,
                         help="append one result line to this .dat file")
     common.add_argument("--json", action="store_true", help="emit one JSON result line")
@@ -66,9 +83,17 @@ def run_tier(problem, args):
 
         return sequential_search(problem)
     if args.tier == "device":
+        if args.engine == "resident":
+            from .engine.resident import resident_search
+
+            return resident_search(problem, m=args.m, M=args.M)
         from .engine.device import device_search
 
         return device_search(problem, m=args.m, M=args.M)
+    if args.tier == "mesh":
+        from .parallel.resident_mesh import mesh_resident_search
+
+        return mesh_resident_search(problem, m=args.m, M=args.M, D=args.D)
     if args.tier == "multi":
         from .parallel.multidevice import multidevice_search
 
@@ -83,6 +108,7 @@ def print_settings(args) -> None:
     tier_names = {
         "seq": "Sequential",
         "device": "Single-device",
+        "mesh": "SPMD device-mesh",
         "multi": "Multi-device",
         "dist": "Distributed multi-device",
     }

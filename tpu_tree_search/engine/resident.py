@@ -135,13 +135,16 @@ class _ResidentProgram:
         self.device = device if device is not None else jax.devices()[0]
         self._step = self._build()
 
-    def _build(self):
-        import jax
+    def loop_fns(self, K: int | None = None):
+        """(cond, body) of the K-cycle device loop over the carry
+        ``(pool_vals, pool_aux, size, best, tree, sol, cycles)`` — reused by
+        the single-device step and, per shard, by the mesh-resident tier."""
         import jax.numpy as jnp
         from jax import lax
 
         n = self.problem.child_slots
-        m, M, K, C = self.m, self.M, self.K, self.capacity
+        m, M, C = self.m, self.M, self.capacity
+        K = self.K if K is None else K
         Mn = M * n
         # The while condition reserves exactly Mn rows of headroom, so the
         # budget must never exceed Mn (a small M would otherwise make the
@@ -218,6 +221,15 @@ class _ResidentProgram:
         def cond(carry):
             _, _, size, _, _, _, cycles = carry
             return (size >= m) & (size + Mn <= C) & (cycles < K)
+
+        return cond, body
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cond, body = self.loop_fns()
 
         def step(pool_vals, pool_aux, size, best):
             zero = jnp.int32(0)
@@ -404,6 +416,22 @@ def default_capacity(M: int, child_slots: int, node_bytes: int) -> int:
     return max(4 * M, min(want, budget))
 
 
+def resolve_capacity(problem: Problem, M: int, capacity: int | None) -> tuple[int, int]:
+    """Shared (capacity, M) resolution for the resident tiers: apply the
+    default_capacity heuristic when unset, then clamp M so one chunk fan-out
+    always fits in half the pool."""
+    n = problem.child_slots
+    if capacity is None:
+        fields = problem.node_fields()
+        node_bytes = sum(
+            int(np.prod(shape, dtype=np.int64)) * dt.itemsize + 4
+            for shape, dt in fields.values()
+        )
+        capacity = default_capacity(M, n, node_bytes)
+    M = min(M, max(64, (capacity // 2) // n))
+    return capacity, M
+
+
 def resident_search(
     problem: Problem,
     m: int = 25,
@@ -426,15 +454,7 @@ def resident_search(
         else getattr(problem, "initial_ub", INF_BOUND)
     )
     n = problem.child_slots
-    if capacity is None:
-        fields = problem.node_fields()
-        node_bytes = sum(
-            int(np.prod(shape, dtype=np.int64)) * dt.itemsize + 4
-            for shape, dt in fields.values()
-        )
-        capacity = default_capacity(M, n, node_bytes)
-    # The device loop needs one chunk fan-out of headroom to run at all.
-    M = min(M, max(64, (capacity // 2) // n))
+    capacity, M = resolve_capacity(problem, M, capacity)
 
     from ..problems.base import index_batch
 

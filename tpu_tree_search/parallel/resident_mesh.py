@@ -1,0 +1,462 @@
+"""Mesh-resident search: the device-resident engine sharded over a TPU mesh.
+
+The reference's multi-GPU tier is host-orchestrated: one CPU task per GPU,
+lock-based pools in host memory, work stealing by locked bulk copies
+(`pfsp_multigpu_chpl.chpl:375-496`). The TPU-native formulation inverts it:
+**one SPMD program owns the whole search**. Every device holds a private pool
+shard in HBM and runs the resident chunk loop (engine/resident.py) locally;
+the cross-device coordination is pure XLA collectives riding ICI:
+
+  * **incumbent all-reduce** — after every K-cycle block the per-shard
+    incumbents fold with ``lax.pmin``. This is the mid-search UB broadcast
+    the reference lacks entirely (it reconciles incumbents only in the
+    terminal reduction, SURVEY.md §2.4.4; BASELINE.json names this the
+    planned improvement).
+  * **diffusion load balancing** — instead of lock-based stealing (which
+    needs shared memory TPUs don't have), each balance round every shard may
+    donate up to T of its *front* (oldest, shallowest — the same
+    steal-half-from-front policy as `Pool_par.chpl:180-191`) nodes to its
+    ring neighbor via ``lax.ppermute``. The donation amounts are computed by
+    every shard from an ``all_gather`` of pool sizes, so sender and receiver
+    agree without any handshake; a round moves work only toward shards that
+    are starving (< m nodes) from shards that can spare it (>= 2m — the
+    reference's steal threshold, `Pool_par.chpl:154-158`).
+  * **termination** — the host loop stops when the all-gathered sizes show
+    every shard below the chunk threshold m; the residual (< D*m nodes)
+    drains on host, exactly like the single-device tier's phase 3. This
+    replaces the idle-flag allIdle scan (`util.chpl:16-30`): in a bulk-
+    synchronous SPMD program the size vector *is* the idle state.
+
+Counting invariance: balancing moves pool nodes between shards but never
+creates/destroys them, and pruning is against the pmin-folded incumbent, so
+with a fixed incumbent (N-Queens; PFSP ub=1) exploredTree/exploredSol equal
+the sequential tier exactly — the same cross-tier determinism the reference
+relies on for validation (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..engine.device import drain, warmup
+from ..engine.resident import _make_program
+from ..engine.results import Diagnostics, PhaseStats, SearchResult
+from ..pool import SoAPool
+from ..problems.base import INF_BOUND, Problem, index_batch
+
+
+class _MeshResidentProgram:
+    """Compiled SPMD step for (problem, mesh, m, M, K, rounds, T, C)."""
+
+    def __init__(
+        self,
+        problem: Problem,
+        mesh,
+        m: int,
+        M: int,
+        K: int,
+        rounds: int,
+        T: int,
+        capacity: int,
+    ):
+        import jax
+
+        if len(mesh.axis_names) != 1:
+            raise ValueError("mesh-resident tier needs a single-axis mesh")
+        self.problem = problem
+        self.mesh = mesh
+        self.D = int(mesh.shape[mesh.axis_names[0]])
+        self.m = m
+        self.M = M
+        n = problem.child_slots
+        self.K = max(1, min(K, (2**31 - 1) // max(1, M * n * max(1, rounds))))
+        self.rounds = rounds
+        self.T = T
+        self.capacity = capacity
+        # Single-device program supplies the pool schema, hooks, and the
+        # K-cycle loop body; its own jitted step is unused here.
+        self.inner = _make_program(
+            problem, m, M, K, capacity, jax.devices()[0]
+        )
+        self._build()
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        D, m, M, T, C = self.D, self.m, self.M, self.T, self.capacity
+        K = self.K
+        n = self.problem.child_slots
+        Mn = M * n
+        vals_dt = self.inner.pool_fields[0][1]
+        aux_dt = self.inner.pool_fields[1][1]
+        cond, body = self.inner.loop_fns(K)
+        rounds = self.rounds
+        perm = [(i, (i + 1) % D) for i in range(D)]  # ring, static
+
+        def shard_step(pool_vals, pool_aux, size, best):
+            # per-shard views: (C, n), (C,), (1,), (1,)
+            sz = size[0]
+            bst = best[0]
+            # Zeros derived from a varying value: under shard_map the while
+            # carry's varying-manual-axes types must match (scan-vma rule).
+            tree = sz * 0
+            sol = sz * 0
+            cycles = sz * 0
+            for _ in range(rounds):
+                carry = lax.while_loop(
+                    cond,
+                    body,
+                    (pool_vals, pool_aux, sz, bst, sz * 0, sz * 0, sz * 0),
+                )
+                pool_vals, pool_aux, sz, bst, ti, si, cy = carry
+                tree += ti
+                sol += si
+                cycles += cy
+                # Incumbent all-reduce over ICI (north-star improvement).
+                # pcast re-marks the reduced (axis-invariant) value as
+                # varying so the next round's while-loop carry types match.
+                bst = lax.pcast(lax.pmin(bst, axis), (axis,), to="varying")
+                if D > 1:
+                    # -- diffusion balance round -------------------------------
+                    sizes = lax.all_gather(sz, axis)  # (D,)
+                    me = lax.axis_index(axis)
+                    right = (me + 1) % D
+                    # Donations computed identically on every shard from the
+                    # gathered size vector: shard i gives to i+1 iff the
+                    # receiver starves (< m) and the donor can spare (>= 2m,
+                    # the reference's steal threshold), capped by the block
+                    # size and the receiver's free space.
+                    recv_sz = jnp.take(sizes, right)
+                    recv_room = recv_sz + T + Mn <= C
+                    my_give = jnp.where(
+                        (recv_sz < m) & (sz >= 2 * m) & recv_room,
+                        jnp.minimum(sz // 2, T),
+                        0,
+                    )
+                    # The amount arriving from the left neighbor, recomputed
+                    # from the same gathered vector (no handshake needed).
+                    left = (me - 1) % D
+                    left_sz = jnp.take(sizes, left)
+                    my_room = sz + T + Mn <= C
+                    incoming = jnp.where(
+                        (sz < m) & (left_sz >= 2 * m) & my_room,
+                        jnp.minimum(left_sz // 2, T),
+                        0,
+                    )
+                    # Donate the pool *front* (oldest, shallowest subtrees —
+                    # `Pool_par.chpl:180-191`): the first T rows are a static
+                    # slice; rows beyond my_give are garbage the receiver
+                    # never marks live.
+                    blk_vals = lax.ppermute(pool_vals[:T], axis, perm)
+                    blk_aux = lax.ppermute(pool_aux[:T], axis, perm)
+                    # Remove donated front rows by rolling them to the dead
+                    # tail region — gated: the dynamic-shift roll copies the
+                    # whole pool, so skip it in the common no-donation case.
+                    def _shed(pv, pa):
+                        return (
+                            jnp.roll(pv, -my_give, axis=0),
+                            jnp.roll(pa, -my_give, axis=0),
+                        )
+
+                    pool_vals, pool_aux = lax.cond(
+                        my_give > 0, _shed, lambda pv, pa: (pv, pa),
+                        pool_vals, pool_aux,
+                    )
+                    sz = sz - my_give
+                    # Append the incoming block only when this shard has T
+                    # rows of dead space (my_room; incoming is gated on the
+                    # same predicate) — an unconditional write could clobber
+                    # live rows of a nearly-full pool.
+                    def _append(pv, pa):
+                        pv = lax.dynamic_update_slice(pv, blk_vals, (sz, 0))
+                        pa = lax.dynamic_update_slice(pa, blk_aux, (sz,))
+                        return pv, pa
+
+                    pool_vals, pool_aux = lax.cond(
+                        my_room, _append, lambda pv, pa: (pv, pa),
+                        pool_vals, pool_aux,
+                    )
+                    sz = sz + incoming
+            return (
+                pool_vals,
+                pool_aux,
+                sz[None],
+                bst[None],
+                tree[None],
+                sol[None],
+                cycles[None],
+            )
+
+        specs_pool = P(axis, None)
+        specs_vec = P(axis)
+        mapped = jax.shard_map(
+            shard_step,
+            mesh=mesh,
+            in_specs=(specs_pool, specs_vec, specs_vec, specs_vec),
+            out_specs=(
+                specs_pool, specs_vec, specs_vec, specs_vec,
+                specs_vec, specs_vec, specs_vec,
+            ),
+        )
+        self._step = jax.jit(mapped, donate_argnums=(0, 1))
+
+        sh_vec = NamedSharding(mesh, specs_vec)
+
+        def init(fr_vals, fr_aux, counts, best0):
+            # fr_*: (D, F, ...) stride-partitioned warm frontier, small.
+            def shard_init(fr_v, fr_a, cnt, b0):
+                pv = jnp.zeros((C, n), vals_dt)
+                pa = jnp.zeros((C,), aux_dt)
+                pv = lax.dynamic_update_slice(pv, fr_v[0].astype(vals_dt), (0, 0))
+                pa = lax.dynamic_update_slice(pa, fr_a[0].astype(aux_dt), (0,))
+                return pv, pa, cnt, b0
+
+            return jax.shard_map(
+                shard_init,
+                mesh=mesh,
+                in_specs=(P(axis, None, None), P(axis, None), specs_vec, specs_vec),
+                out_specs=(specs_pool, specs_vec, specs_vec, specs_vec),
+            )(fr_vals, fr_aux, counts, best0)
+
+        self._init = jax.jit(init)
+        self._sh_vec = sh_vec
+
+        def residual(pool_vals, pool_aux):
+            # After termination every shard holds < m live rows; ship the
+            # first 2m rows of each shard to host (static, tiny).
+            R = min(2 * m, C)
+
+            def shard_res(pv, pa):
+                return pv[None, :R], pa[None, :R]
+
+            return jax.shard_map(
+                shard_res,
+                mesh=mesh,
+                in_specs=(specs_pool, specs_vec),
+                out_specs=(P(axis, None, None), P(axis, None)),
+            )(pool_vals, pool_aux)
+
+        self._residual = jax.jit(residual)
+
+    # -- host API ----------------------------------------------------------
+
+    def init_state(self, shard_batches: list[dict], best: int):
+        import jax
+
+        D = self.D
+        name_v, _, shape_v = self.inner.pool_fields[0]
+        name_a = self.inner.pool_fields[1][0]
+        counts = np.array(
+            [b[name_a].shape[0] for b in shard_batches], dtype=np.int32
+        )
+        F = max(1, int(counts.max()))
+        if F > self.capacity:
+            raise ValueError(
+                f"warm frontier ({F} nodes/shard) exceeds pool capacity "
+                f"{self.capacity}"
+            )
+        fr_v = np.zeros((D, F) + shape_v, dtype=np.int32)
+        fr_a = np.zeros((D, F), dtype=np.int32)
+        for w, b in enumerate(shard_batches):
+            k = counts[w]
+            if k:
+                fr_v[w, :k] = b[name_v]
+                fr_a[w, :k] = b[name_a]
+        best0 = np.full((D,), best, dtype=np.int32)
+        return self._init(fr_v, fr_a, jax.device_put(counts, self._sh_vec), best0)
+
+    def step(self, state):
+        return self._step(*state)
+
+    def read_stats(self, out):
+        *state, tree, sol, cycles = out
+        sizes = np.asarray(state[2])
+        best = int(np.asarray(state[3]).min())
+        return (
+            tuple(state),
+            int(np.asarray(tree).sum()),
+            int(np.asarray(sol).sum()),
+            int(np.asarray(cycles).sum()),
+            sizes,
+            best,
+            np.asarray(tree),
+        )
+
+    def residual_batch(self, state) -> dict:
+        pool_vals, pool_aux, size, _ = state
+        rv, ra = self._residual(pool_vals, pool_aux)
+        return self._collect(np.asarray(rv), np.asarray(ra), np.asarray(size))
+
+    def full_batch(self, state) -> dict:
+        """Every live node of every shard (saturation-fallback download)."""
+        pool_vals, pool_aux, size, _ = state
+        sizes = np.asarray(size)
+        rv = np.asarray(pool_vals).reshape(self.D, self.capacity, -1)
+        ra = np.asarray(pool_aux).reshape(self.D, self.capacity)
+        return self._collect(rv, ra, sizes)
+
+    def _collect(self, rv, ra, sizes) -> dict:
+        name_v = self.inner.pool_fields[0][0]
+        name_a = self.inner.pool_fields[1][0]
+        fields = self.problem.node_fields()
+        parts_v = [rv[w, : sizes[w]] for w in range(self.D)]
+        parts_a = [ra[w, : sizes[w]] for w in range(self.D)]
+        batch = {
+            name_v: np.concatenate(parts_v).astype(fields[name_v][1]),
+            name_a: np.concatenate(parts_a).astype(fields[name_a][1]),
+        }
+        return self.inner.derive_fields(batch)
+
+
+def mesh_resident_search(
+    problem: Problem,
+    m: int = 25,
+    M: int = 16384,
+    K: int = 16,
+    rounds: int = 2,
+    T: int | None = None,
+    capacity: int | None = None,
+    mesh=None,
+    devices=None,
+    D: int | None = None,
+    initial_best: int | None = None,
+    warmup_target: int | None = None,
+) -> SearchResult:
+    """SPMD multi-device search: 3 phases like every tier, with phase 2 one
+    sharded resident program (see module docstring)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if mesh is None:
+        if devices is None:
+            devices = jax.devices()
+        if D is None:
+            D = len(devices)
+        mesh = Mesh(np.asarray(devices[:D]), ("dp",))
+    if len(mesh.axis_names) != 1:
+        raise ValueError("mesh-resident tier needs a single-axis mesh")
+    D = int(mesh.shape[mesh.axis_names[0]])
+    n = problem.child_slots
+    from ..engine.resident import resolve_capacity
+
+    capacity, M = resolve_capacity(problem, M, capacity)
+    if T is None:
+        T = max(2 * m, min(M, 8192))
+
+    best = (
+        initial_best
+        if initial_best is not None
+        else getattr(problem, "initial_ub", INF_BOUND)
+    )
+    pool = SoAPool(problem.node_fields())
+    pool.push_back(index_batch(problem.root(), 0))
+
+    diagnostics = Diagnostics()
+    phases: list[PhaseStats] = []
+    t0 = time.perf_counter()
+
+    # -- phase 1: host warm-up to D*m (`nqueens_multigpu_chpl.chpl:173`) ---
+    target = D * m if warmup_target is None else warmup_target
+    tree1, sol1, best = warmup(problem, pool, best, target)
+    t1 = time.perf_counter()
+    phases.append(PhaseStats(t1 - t0, tree1, sol1))
+
+    # -- phase 2: SPMD resident loop ---------------------------------------
+    # Cache the compiled SPMD program on the problem (recompiling the
+    # shard_map'd while-loop costs ~30s on TPU, cf. _make_program).
+    cache = getattr(problem, "_mesh_programs", None)
+    if cache is None:
+        cache = problem._mesh_programs = {}
+    key = (tuple(id(d) for d in mesh.devices.flat), m, M, K, rounds, T, capacity)
+    program = cache.get(key)
+    if program is None:
+        program = cache[key] = _MeshResidentProgram(
+            problem, mesh, m, M, K, rounds, T, capacity
+        )
+
+    def upload(warm_batch):
+        # Static stride-D partition (`nqueens_multigpu_chpl.chpl:221-225`).
+        shards = [{k: v[w::D] for k, v in warm_batch.items()} for w in range(D)]
+        return program.init_state(shards, best)
+
+    state = upload(pool.as_batch())
+    pool.clear()
+    diagnostics.host_to_device += 1
+
+    tree2 = 0
+    sol2 = 0
+    per_worker = np.zeros(D, dtype=np.int64)
+    prev_sizes = None
+    offloader = None
+    while True:
+        out = program.step(state)
+        state, ti, si, cy, sizes, best, tree_vec = program.read_stats(out)
+        tree2 += ti
+        sol2 += si
+        per_worker += tree_vec.astype(np.int64)
+        diagnostics.kernel_launches += cy
+        if int(sizes.max()) < m:
+            break
+        if cy == 0 and prev_sizes is not None and np.array_equal(sizes, prev_sizes):
+            # Saturation: no shard ran a cycle and balancing moved nothing.
+            # Fall back to host offload cycles (same guarantee as the
+            # single-device tier) until the frontier fits again.
+            from ..engine.device import DeviceOffloader, bucket_size
+
+            pool.reset_from(program.full_batch(state))
+            diagnostics.device_to_host += 1
+            if offloader is None:
+                offloader = DeviceOffloader(problem, jax.devices()[0])
+            chunk_buf = problem.empty_batch(M)
+            fits = D * max(0, capacity - 2 * M * n)
+            while pool.size >= m and pool.size > fits:
+                count = pool.pop_back_bulk(m, M, chunk_buf)
+                if count == 0:
+                    break
+                bucket = bucket_size(count, m, M)
+                snapshot = {k: v[:count].copy() for k, v in chunk_buf.items()}
+                dev = offloader.dispatch(snapshot, count, bucket, best)
+                res = problem.generate_children(
+                    snapshot, count, offloader.collect(dev), best
+                )
+                tree2 += res.tree_inc
+                sol2 += res.sol_inc
+                best = res.best
+                pool.push_back_bulk(res.children)
+            diagnostics.kernel_launches += offloader.diagnostics.kernel_launches
+            offloader.diagnostics = Diagnostics()
+            state = upload(pool.as_batch())
+            pool.clear()
+            diagnostics.host_to_device += 1
+            prev_sizes = None
+            continue
+        prev_sizes = sizes
+    batch = program.residual_batch(state)
+    diagnostics.device_to_host += 1
+    pool.reset_from(batch)
+    t2 = time.perf_counter()
+    phases.append(PhaseStats(t2 - t1, tree2, sol2))
+
+    # -- phase 3: host drain ------------------------------------------------
+    tree3, sol3, best = drain(problem, pool, best)
+    t3 = time.perf_counter()
+    phases.append(PhaseStats(t3 - t2, tree3, sol3))
+
+    return SearchResult(
+        explored_tree=tree1 + tree2 + tree3,
+        explored_sol=sol1 + sol2 + sol3,
+        best=best,
+        elapsed=t3 - t0,
+        phases=phases,
+        diagnostics=diagnostics,
+        per_worker_tree=per_worker.tolist(),
+    )
