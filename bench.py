@@ -32,6 +32,10 @@ GOLDEN = {"tree": 2_573_652, "sol": 2648, "makespan": 1377}
 
 
 def main() -> int:
+    from tpu_tree_search.cli import enable_compile_cache
+
+    enable_compile_cache()
+
     from tpu_tree_search.engine.resident import resident_search
     from tpu_tree_search.problems import PFSPProblem
 

@@ -1,0 +1,61 @@
+"""Pallas kernels vs jnp/XLA oracles, bit-for-bit (interpret mode on CPU).
+
+Mirrors the reference's cross-implementation validation strategy: the CUDA
+device bounds are checked against the C host bounds by numeric agreement
+(SURVEY.md §4.3); here the Pallas kernels are checked against the jnp
+evaluators, which are themselves oracle-tested against the NumPy ports of
+`c_bound_simple.c` (tests/test_bounds_oracle.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_tree_search.ops import nqueens_device, pallas_kernels, pfsp_device
+from tpu_tree_search.problems import PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard
+
+
+@pytest.mark.parametrize("g", [1, 3])
+def test_nqueens_labels_match_oracle(g):
+    rng = np.random.default_rng(7)
+    N, B = 11, 700  # B not a tile multiple: exercises padding
+    boards = np.stack([rng.permutation(N).astype(np.uint8) for _ in range(B)])
+    depth = rng.integers(0, N + 1, B).astype(np.int32)
+    oracle = nqueens_device.make_core(N, g)(jnp.asarray(boards), jnp.asarray(depth))
+    got = pallas_kernels.nqueens_labels(
+        jnp.asarray(boards), jnp.asarray(depth), N, g, interpret=True
+    )
+    assert np.array_equal(np.asarray(oracle), np.asarray(got))
+
+
+@pytest.mark.parametrize(
+    "inst,jobs,machines",
+    [(14, 20, 10), (1, 12, 5)],
+)
+def test_lb1_bounds_match_oracle(inst, jobs, machines):
+    rng = np.random.default_rng(3)
+    if jobs == 20:
+        prob = PFSPProblem(inst=inst, lb="lb1", ub=1)
+    else:
+        ptm = taillard.reduced_instance(inst, jobs=jobs, machines=machines)
+        prob = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    B = 300
+    prmu = np.stack([rng.permutation(jobs).astype(np.int32) for _ in range(B)])
+    limit1 = rng.integers(-1, jobs - 1, B).astype(np.int32)
+    oracle = pfsp_device._lb1_chunk(
+        jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads, t.min_tails
+    )
+    got = pallas_kernels.pfsp_lb1_bounds(
+        jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads, t.min_tails,
+        interpret=True,
+    )
+    assert np.array_equal(np.asarray(oracle), np.asarray(got))
+
+
+def test_use_pallas_is_off_on_cpu(monkeypatch):
+    monkeypatch.delenv("TTS_PALLAS", raising=False)
+    assert pallas_kernels.use_pallas() is False  # tests run on the CPU backend

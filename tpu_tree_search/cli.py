@@ -174,8 +174,31 @@ def result_record(args, res) -> dict:
     return rec
 
 
+def enable_compile_cache() -> None:
+    """Persist XLA executables across processes (the resident tiers compile
+    ~30s while-loop programs; the cache makes repeat CLI/bench runs start in
+    seconds). Opt out with TTS_COMPILE_CACHE=0 or point it at a directory."""
+    import os
+
+    want = os.environ.get("TTS_COMPILE_CACHE", "")
+    if want == "0":
+        return
+    path = want or os.path.join(
+        os.path.expanduser("~"), ".cache", "tpu_tree_search", "xla"
+    )
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization; never fail a run over it
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    enable_compile_cache()
     try:
         problem = make_problem(args)
     except ValueError as e:

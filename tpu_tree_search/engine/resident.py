@@ -326,7 +326,7 @@ class _PFSPResident(_ResidentProgram):
 
         def evaluate(prmu_c, limit1_c, valid, best):
             if lb == "lb1":
-                bounds = P._lb1_chunk(prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails)
+                bounds = P.lb1_bounds(prmu_c, limit1_c, t)
             elif lb == "lb1_d":
                 bounds = P._lb1_d_chunk(prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails)
             else:
@@ -375,7 +375,7 @@ class _NQueensResident(_ResidentProgram):
         from ..ops import nqueens_device
 
         N = self.problem.N
-        core = nqueens_device.make_core(N, self.problem.g)
+        core = nqueens_device.make_labels(N, self.problem.g)
 
         def evaluate(board_c, depth_c, valid, best):
             # A popped node at depth == N is a solution (`nqueens_chpl.chpl:74`).

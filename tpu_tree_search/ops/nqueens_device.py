@@ -49,9 +49,19 @@ def make_core(N: int, g: int = 1):
     return core
 
 
+def make_labels(N: int, g: int = 1):
+    """Routed safety evaluator: Pallas kernel on TPU (`pallas_kernels.py`),
+    the jnp/XLA core elsewhere. Same contract as ``make_core``."""
+    from . import pallas_kernels as PK
+
+    if PK.use_pallas():
+        return lambda board, depth: PK.nqueens_labels(board, depth, N, g)
+    return make_core(N, g)
+
+
 @lru_cache(maxsize=None)
 def make_jitted_core(N: int, g: int = 1):
     """Module-level jit cache keyed on (N, g): every DeviceOffloader / worker
     thread shares one compiled kernel per bucket shape instead of re-tracing
     per closure (cf. the module-level jitted PFSP chunk kernels)."""
-    return jax.jit(make_core(N, g))
+    return jax.jit(make_labels(N, g))

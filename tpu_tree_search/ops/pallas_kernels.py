@@ -1,0 +1,210 @@
+"""Pallas TPU kernels for the hot chunk evaluators.
+
+The XLA (jnp) evaluators in `nqueens_device.py` / `pfsp_device.py` are the
+semantic oracles and the portable path; these kernels are the hand-scheduled
+TPU variants: one VMEM-resident pass per batch tile — the instance tables
+(processing times, min heads/tails) are pinned in VMEM for the whole grid,
+every intermediate (the one-hot gather, the O(n) schedule_front scan, the
+per-child bound chain) lives in registers/VMEM, and nothing round-trips
+through HBM between fusion boundaries.
+
+Reference counterparts: `evaluate_gpu` (`nqueens_gpu_chpl.chpl:97-123`) and
+`evaluate_gpu_lb1` (`evaluate.cu:25-49`, device math `c_bounds_gpu.cu:15-195`)
+— one SIMT thread per (parent, child); here one grid step per TILE_B parents
+with all children vectorized on the VPU/MXU.
+
+Selection: ``use_pallas()`` returns True on TPU backends unless disabled via
+``TTS_PALLAS=0``; tests force ``interpret=True`` on CPU to check the kernels
+bit-for-bit against the jnp oracles.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def use_pallas() -> bool:
+    if os.environ.get("TTS_PALLAS", "1") == "0":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _round_up(x: int, k: int) -> int:
+    return (x + k - 1) // k * k
+
+
+# ---------------------------------------------------------------------------
+# N-Queens safety labels
+# ---------------------------------------------------------------------------
+
+
+def _nqueens_kernel(board_ref, depth_ref, out_ref, *, N: int, g: int):
+    """labels[b, k] = 1 iff board[b, k] placed at column depth_b clashes with
+    no placed queen on either diagonal (`nqueens_gpu_chpl.chpl:99-123`)."""
+    board = board_ref[:].astype(jnp.int32)  # (T, N)
+    depth = depth_ref[:, 0].astype(jnp.int32)  # (T,)
+    qk = board[:, None, :]  # candidate rows (T, 1, N)
+    bi = board[:, :, None]  # placed queens  (T, N, 1)
+    i = jax.lax.broadcasted_iota(jnp.int32, (1, N, 1), 1)
+    d = depth[:, None, None] - i  # (T, N, 1)
+    placed = i < depth[:, None, None]
+
+    def one_round(_, safe):
+        clash = (bi == qk - d) | (bi == qk + d)
+        return safe & ~jnp.any(clash & placed, axis=1)
+
+    safe = one_round(0, jnp.ones(board.shape, dtype=bool))
+    if g > 1:
+        safe = jax.lax.fori_loop(0, g - 1, one_round, safe)
+    k = jax.lax.broadcasted_iota(jnp.int32, board.shape, 1)
+    out_ref[:] = (safe & (k >= depth[:, None])).astype(jnp.uint8)
+
+
+@lru_cache(maxsize=None)
+def _nqueens_call(N: int, g: int, B: int, tile: int, interpret: bool):
+    kernel = partial(_nqueens_kernel, N=N, g=g)
+    grid = (B // tile,)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, N), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+
+
+def nqueens_labels(board, depth, N: int, g: int = 1, interpret: bool = False):
+    """(B, N) uint8 labels; same contract as `nqueens_device.make_core`."""
+    B = board.shape[0]
+    tile = min(512, B)
+    Bp = _round_up(B, tile)
+    if Bp != B:
+        board = jnp.pad(board, ((0, Bp - B), (0, 0)))
+        depth = jnp.pad(depth, ((0, Bp - B),))
+    out = _nqueens_call(N, g, Bp, tile, interpret)(
+        board.astype(jnp.int32), depth.astype(jnp.int32)[:, None]
+    )
+    return out[:B]
+
+
+# ---------------------------------------------------------------------------
+# PFSP lb1 child bounds
+# ---------------------------------------------------------------------------
+
+
+def _lb1_kernel(
+    prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, *, n: int, m: int
+):
+    """Full lb1 bound of every child of every parent in the tile.
+
+    Math identical to `ops/pfsp_device._lb1_chunk` (itself the batched form
+    of `c_bound_simple.c:51-141` + one incremental `add_forward` per child);
+    here the whole chain runs on one VMEM tile: one-hot MXU gather of the
+    per-position processing times, the O(n) schedule_front scan, the O(m)
+    child update, and the machine-bound max chain.
+    """
+    prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
+    limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
+    ptm = ptm_ref[:].astype(jnp.float32)  # (n, m) job-major
+    T = prmu.shape[0]
+
+    # ptg[b, i, :] = ptm[prmu[b, i]] via one-hot matmul (exact: ints < 2^24).
+    jobs_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n, n), 2)
+    onehot = (jobs_iota == prmu[:, :, None]).astype(jnp.float32)
+    ptg = jax.lax.dot_general(
+        onehot.reshape(T * n, n),
+        ptm,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,  # MXU default bf16 pass rounds ints > 256
+    ).reshape(T, n, m).astype(jnp.int32)
+
+    # schedule_front(prmu, limit1): n-step scan, masked per row.
+    front = jnp.zeros((T, m), jnp.int32)
+
+    def scan_step(i, front):
+        pt = ptg[:, i, :]
+        cols = [front[:, 0] + pt[:, 0]]
+        for j in range(1, m):
+            cols.append(jnp.maximum(cols[-1], front[:, j]) + pt[:, j])
+        newf = jnp.stack(cols, axis=-1)
+        return jnp.where((i <= limit1)[:, None], newf, front)
+
+    front = jax.lax.fori_loop(0, n, scan_step, front)
+    front = jnp.where((limit1 == -1)[:, None], heads_ref[:], front)
+
+    # remaining work per machine after removing the child job.
+    unsched = (
+        jax.lax.broadcasted_iota(jnp.int32, (T, n), 1) >= (limit1 + 1)[:, None]
+    ).astype(jnp.int32)
+    remain = jnp.sum(ptg * unsched[:, :, None], axis=1)  # (T, m)
+
+    # Child k: one add_forward step + machine bound chain, unrolled over m.
+    tails = tails_ref[:][0]  # (m,)
+    f = front[:, None, :]  # (T, 1, m)
+    cf0 = f[..., 0] + ptg[..., 0]  # child front, machine 0: (T, n)
+    child_front = [cf0]
+    for j in range(1, m):
+        child_front.append(jnp.maximum(child_front[-1], f[..., j]) + ptg[..., j])
+    cremain = remain[:, None, :] - ptg  # (T, n, m)
+    tmp0 = child_front[0] + cremain[..., 0]
+    lb = tmp0 + tails[0]
+    for i in range(1, m):
+        tmp1 = jnp.maximum(tmp0, child_front[i] + cremain[..., i])
+        lb = jnp.maximum(lb, tmp1 + tails[i])
+        tmp0 = tmp1
+    out_ref[:] = lb
+
+
+@lru_cache(maxsize=None)
+def _lb1_call(n: int, m: int, B: int, tile: int, interpret: bool):
+    kernel = partial(_lb1_kernel, n=n, m=m)
+    grid = (B // tile,)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, n), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+
+
+def pfsp_lb1_bounds(
+    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False
+):
+    """(B, n) int32 lb1 child bounds; same contract as `_lb1_chunk`."""
+    B, n = prmu.shape
+    m = ptm_t.shape[1]
+    tile = min(256, B)
+    Bp = _round_up(B, tile)
+    if Bp != B:
+        prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
+        limit1 = jnp.pad(limit1, ((0, Bp - B),))
+    out = _lb1_call(n, m, Bp, tile, interpret)(
+        prmu.astype(jnp.int32),
+        limit1.astype(jnp.int32)[:, None],
+        ptm_t.astype(jnp.int32),
+        min_heads.astype(jnp.int32)[None, :],
+        min_tails.astype(jnp.int32)[None, :],
+    )
+    return out[:B]

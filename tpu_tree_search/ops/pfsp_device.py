@@ -239,6 +239,20 @@ class PFSPDeviceTables:
         self.johnson_schedules = jnp.asarray(lb2_data.johnson_schedules, dtype=jnp.int32)
 
 
+def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables"):
+    """lb1 chunk bounds, routed: Pallas kernel on TPU (VMEM-resident tile
+    pass, `ops/pallas_kernels.py`), the jnp/XLA oracle elsewhere."""
+    from . import pallas_kernels as PK
+
+    # Same n-gate as gather_ptimes: the kernel's (tile, n, n) one-hot stays
+    # within VMEM only for small job counts; large instances use the oracle.
+    if PK.use_pallas() and prmu.shape[-1] <= 64:
+        return PK.pfsp_lb1_bounds(
+            prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails
+        )
+    return _lb1_chunk(prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails)
+
+
 def make_evaluator(tables: PFSPDeviceTables, lb: str):
     """Dispatcher over the three bounds (`pfsp_gpu_chpl.chpl:256-270`).
 
@@ -247,10 +261,7 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str):
     if lb == "lb1":
         def evaluate(parents, count, best):
             del count, best
-            return _lb1_chunk(
-                parents["prmu"], parents["limit1"], tables.ptm_t,
-                tables.min_heads, tables.min_tails,
-            )
+            return lb1_bounds(parents["prmu"], parents["limit1"], tables)
     elif lb == "lb1_d":
         def evaluate(parents, count, best):
             del count, best
