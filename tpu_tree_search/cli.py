@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     common.add_argument("--m", type=int, default=25, help="minimum chunk size")
     common.add_argument("--M", type=int, default=50000, help="maximum chunk size")
+    common.add_argument("--K", type=int, default=None,
+                        help="resident tiers: device chunk cycles per host "
+                        "dispatch (default 4096 device / 16 mesh)")
     common.add_argument(
         "--D", type=int, default=None,
         help="number of devices/shards (mesh, multi, dist tiers); "
@@ -54,6 +57,16 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_argument("--stats-file", type=str, default=None,
                         help="append one result line to this .dat file")
     common.add_argument("--json", action="store_true", help="emit one JSON result line")
+    common.add_argument("--checkpoint", type=str, default=None,
+                        help="save the search frontier to this file periodically "
+                        "(device/mesh tiers)")
+    common.add_argument("--checkpoint-interval", type=float, default=60.0,
+                        help="seconds between checkpoint snapshots")
+    common.add_argument("--resume", type=str, default=None,
+                        help="resume a search from a checkpoint file")
+    common.add_argument("--max-steps", type=int, default=None,
+                        help="stop after this many device dispatches "
+                        "(checkpointing cutoff; result is marked incomplete)")
 
     nq = sub.add_parser("nqueens", parents=[common], help="N-Queens backtracking")
     nq.add_argument("--N", type=int, default=14, help="number of queens")
@@ -78,6 +91,22 @@ def make_problem(args):
 
 
 def run_tier(problem, args):
+    ckpt_kw = dict(
+        max_steps=args.max_steps,
+        checkpoint_path=args.checkpoint,
+        checkpoint_interval_s=args.checkpoint_interval,
+        resume_from=args.resume,
+    )
+    wants_resident = (
+        args.checkpoint is not None
+        or args.resume is not None
+        or args.max_steps is not None
+        or args.K is not None
+    )
+    if args.tier not in ("device", "mesh") and wants_resident:
+        raise NotImplementedError(
+            "--checkpoint/--resume/--max-steps/--K need the device or mesh tier"
+        )
     if args.tier == "seq":
         from .engine import sequential_search
 
@@ -86,14 +115,24 @@ def run_tier(problem, args):
         if args.engine == "resident":
             from .engine.resident import resident_search
 
-            return resident_search(problem, m=args.m, M=args.M)
+            if args.K is not None:
+                ckpt_kw["K"] = args.K
+            return resident_search(problem, m=args.m, M=args.M, **ckpt_kw)
+        if wants_resident:
+            raise NotImplementedError(
+                "--checkpoint/--resume/--max-steps/--K need the resident engine"
+            )
         from .engine.device import device_search
 
         return device_search(problem, m=args.m, M=args.M)
     if args.tier == "mesh":
         from .parallel.resident_mesh import mesh_resident_search
 
-        return mesh_resident_search(problem, m=args.m, M=args.M, D=args.D)
+        if args.K is not None:
+            ckpt_kw["K"] = args.K
+        return mesh_resident_search(
+            problem, m=args.m, M=args.M, D=args.D, **ckpt_kw
+        )
     if args.tier == "multi":
         from .parallel.multidevice import multidevice_search
 
@@ -139,7 +178,10 @@ def print_results(args, problem, res) -> None:
             print(f"Size of the explored tree: {ph.tree}")
             print(f"Number of explored solutions: {ph.sol}")
             print(f"Elapsed time: {ph.seconds:.6f} [s]")
-    print("\nExploration terminated.")
+    if res.complete:
+        print("\nExploration terminated.")
+    else:
+        print("\nExploration interrupted (checkpointed; resume with --resume).")
     print("\n=================================================")
     print(f"Size of the explored tree: {res.explored_tree}")
     print(f"Number of explored solutions: {res.explored_sol}")
@@ -167,6 +209,8 @@ def result_record(args, res) -> dict:
         "explored_sol": res.explored_sol,
         "elapsed_s": round(res.elapsed, 6),
     }
+    if not res.complete:
+        rec["complete"] = False
     if args.problem == "pfsp":
         rec.update(inst=args.inst, lb=args.lb, ub=args.ub, optimum=res.best)
     else:

@@ -1,0 +1,122 @@
+"""Checkpoint / resume.
+
+The reference has no checkpointing (SURVEY.md §5: a crashed run loses the
+search). But the pool *is* the complete search state — the frontier plus the
+incumbent and the counters determine the rest of the run exactly — so a
+checkpoint is one serialized NodeBatch + four scalars. The resident tiers
+snapshot on a wall-clock cadence (downloading the device pool costs one
+host transfer, so snapshots are amortized over many K-cycle blocks); a
+resumed search seeds phase 2 from the saved frontier and keeps counting
+where the saved run stopped.
+
+Format: one ``.npz`` written atomically (tmp + rename), holding the node
+fields plus a JSON header identifying the problem. Resuming validates the
+header against the live problem to refuse mixing incompatible searches.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..problems.base import NodeBatch, Problem
+
+FORMAT_VERSION = 1
+
+
+class RunController:
+    """Shared max-steps / periodic-checkpoint bookkeeping for the resident
+    tiers. ``snapshot_fn() -> (batch, best)`` downloads the live frontier;
+    ``after_step(tree, sol)`` returns True when the run must stop now (the
+    cutoff checkpoint, if requested, has already been written)."""
+
+    def __init__(
+        self,
+        problem: Problem,
+        checkpoint_path: str | None,
+        interval_s: float,
+        max_steps: int | None,
+        snapshot_fn,
+    ):
+        import time
+
+        self.problem = problem
+        self.path = checkpoint_path
+        self.interval_s = interval_s
+        self.max_steps = max_steps
+        self.snapshot_fn = snapshot_fn
+        self.steps = 0
+        self._clock = time.monotonic
+        self._last = self._clock()
+
+    def _save(self, tree: int, sol: int) -> None:
+        batch, best = self.snapshot_fn()
+        save(self.path, self.problem, batch, best, tree, sol)
+
+    def after_step(self, tree: int, sol: int) -> bool:
+        self.steps += 1
+        if self.max_steps is not None and self.steps >= self.max_steps:
+            if self.path is not None:
+                self._save(tree, sol)
+            return True
+        if self.path is not None and self._clock() - self._last >= self.interval_s:
+            self._save(tree, sol)
+            self._last = self._clock()
+        return False
+
+
+@dataclass
+class Checkpoint:
+    meta: dict  # problem identity, see problem_meta()
+    batch: NodeBatch  # the frontier
+    best: int
+    tree: int
+    sol: int
+
+
+def problem_meta(problem: Problem) -> dict:
+    meta = {"problem": problem.name}
+    if problem.name == "nqueens":
+        meta.update(N=problem.N, g=problem.g)
+    elif problem.name == "pfsp":
+        meta.update(inst=getattr(problem, "inst", None), lb=problem.lb,
+                    ub=problem.ub, jobs=problem.jobs, machines=problem.machines)
+    return meta
+
+
+def save(path: str, problem: Problem, batch: NodeBatch, best: int, tree: int, sol: int) -> None:
+    header = {
+        "version": FORMAT_VERSION,
+        "meta": problem_meta(problem),
+        "best": int(best),
+        "tree": int(tree),
+        "sol": int(sol),
+        "fields": sorted(batch.keys()),
+    }
+    arrays = {f"field_{k}": v for k, v in batch.items()}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f, header=np.frombuffer(json.dumps(header).encode(), dtype=np.uint8),
+            **arrays,
+        )
+    os.replace(tmp, path)
+
+
+def load(path: str, problem: Problem) -> Checkpoint:
+    with np.load(path) as data:
+        header = json.loads(bytes(data["header"]).decode())
+        if header["version"] != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version {header['version']}")
+        if header["meta"] != problem_meta(problem):
+            raise ValueError(
+                f"checkpoint is for {header['meta']}, not {problem_meta(problem)}"
+            )
+        batch = {k: data[f"field_{k}"] for k in header["fields"]}
+    return Checkpoint(
+        meta=header["meta"], batch=batch,
+        best=header["best"], tree=header["tree"], sol=header["sol"],
+    )

@@ -284,6 +284,18 @@ class _ResidentProgram:
             batch[name] = host.astype(fields[name][1])
         return self.derive_fields(batch), size, best
 
+    def snapshot(self, state) -> tuple[dict, int, int]:
+        """Full live-frontier download (checkpointing): one whole-pool
+        transfer, sliced to the live rows."""
+        *pools, size, best = state
+        size = int(size)
+        best = int(best)
+        fields = self.problem.node_fields()
+        batch = {}
+        for (name, _, _), buf in zip(self.pool_fields, pools):
+            batch[name] = np.asarray(buf)[:size].astype(fields[name][1])
+        return self.derive_fields(batch), size, best
+
 
 class _PFSPResident(_ResidentProgram):
     size_field = "prmu"
@@ -441,12 +453,22 @@ def resident_search(
     device=None,
     initial_best: int | None = None,
     warmup_target: int | None = None,
+    max_steps: int | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 60.0,
+    resume_from: str | None = None,
 ) -> SearchResult:
     """3-phase search with a device-resident hot loop.
 
     Phase 1 (host warm-up) and phase 3 (host drain) are identical to
     `device_search`; phase 2 runs on-device in blocks of up to K chunk
     cycles per dispatch.
+
+    Checkpointing (absent from the reference, SURVEY.md §5): with
+    ``checkpoint_path`` the live frontier + counters are saved every
+    ``checkpoint_interval_s`` and at a ``max_steps`` cutoff (which returns
+    ``complete=False``); ``resume_from`` seeds the search from a saved file
+    and keeps counting.
     """
     best = (
         initial_best
@@ -457,17 +479,28 @@ def resident_search(
     capacity, M = resolve_capacity(problem, M, capacity)
 
     from ..problems.base import index_batch
+    from . import checkpoint as ckpt
 
     pool = SoAPool(problem.node_fields())
-    pool.push_back(index_batch(problem.root(), 0))
-
     diagnostics = Diagnostics()
     phases: list[PhaseStats] = []
     t0 = time.perf_counter()
 
-    # -- phase 1: host warm-up --------------------------------------------
-    target = m if warmup_target is None else warmup_target
-    tree1, sol1, best = warmup(problem, pool, best, target)
+    # -- phase 1: host warm-up (or checkpoint restore) ---------------------
+    if resume_from is not None:
+        saved = ckpt.load(resume_from, problem)
+        pool.push_back_bulk(saved.batch)
+        tree1, sol1 = saved.tree, saved.sol
+        # Keep the tighter incumbent: the resumed run may supply a better one
+        # (e.g. ub=1 after a ub=0 checkpoint).
+        best = min(best, saved.best)
+        # A resumed frontier can exceed the warm-up-sized pool: grow the
+        # capacity so the whole frontier plus one fan-out fits.
+        capacity = max(capacity, pool.size + 2 * M * n)
+    else:
+        pool.push_back(index_batch(problem.root(), 0))
+        target = m if warmup_target is None else warmup_target
+        tree1, sol1, best = warmup(problem, pool, best, target)
     t1 = time.perf_counter()
     phases.append(PhaseStats(t1 - t0, tree1, sol1))
 
@@ -479,6 +512,16 @@ def resident_search(
     tree2 = 0
     sol2 = 0
     offloader = None
+
+    def snapshot_fn():
+        batch, _, bst = program.snapshot(state)
+        diagnostics.device_to_host += 1
+        return batch, bst
+
+    controller = ckpt.RunController(
+        problem, checkpoint_path, checkpoint_interval_s, max_steps, snapshot_fn
+    )
+
     while True:
         out = program.step(state)
         state, tree_inc, sol_inc, cycles = program.read(out)
@@ -489,6 +532,18 @@ def resident_search(
         best = int(state[-1])
         if size < m:
             break
+        if controller.after_step(tree1 + tree2, sol1 + sol2):
+            t2 = time.perf_counter()
+            phases.append(PhaseStats(t2 - t1, tree2, sol2))
+            return SearchResult(
+                explored_tree=tree1 + tree2,
+                explored_sol=sol1 + sol2,
+                best=best,
+                elapsed=t2 - t0,
+                phases=phases,
+                diagnostics=diagnostics,
+                complete=False,
+            )
         if cycles == 0:
             # Capacity stall: pool too full for another device fan-out. Run
             # classic offload cycles through a host pool until there is

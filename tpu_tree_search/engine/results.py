@@ -41,6 +41,9 @@ class SearchResult:
     diagnostics: Diagnostics = field(default_factory=Diagnostics)
     # multi-device extras (`pfsp_multigpu_chpl.chpl:518-522`)
     per_worker_tree: list[int] = field(default_factory=list)
+    # False when the run stopped early (max_steps cutoff) and saved a
+    # checkpoint instead of finishing; counters cover work done so far.
+    complete: bool = True
 
     def workload_shares(self) -> list[float]:
         """Per-worker share of explored nodes (load-balance report,

@@ -330,9 +330,15 @@ def mesh_resident_search(
     D: int | None = None,
     initial_best: int | None = None,
     warmup_target: int | None = None,
+    max_steps: int | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 60.0,
+    resume_from: str | None = None,
 ) -> SearchResult:
     """SPMD multi-device search: 3 phases like every tier, with phase 2 one
-    sharded resident program (see module docstring)."""
+    sharded resident program (see module docstring). Checkpoint/resume as in
+    ``resident_search`` (a mesh snapshot merges every shard's frontier, and a
+    resumed frontier re-partitions stride-D, so D may change across runs)."""
     import jax
     from jax.sharding import Mesh
 
@@ -357,16 +363,29 @@ def mesh_resident_search(
         if initial_best is not None
         else getattr(problem, "initial_ub", INF_BOUND)
     )
-    pool = SoAPool(problem.node_fields())
-    pool.push_back(index_batch(problem.root(), 0))
+    from ..engine import checkpoint as ckpt
 
+    pool = SoAPool(problem.node_fields())
     diagnostics = Diagnostics()
     phases: list[PhaseStats] = []
     t0 = time.perf_counter()
 
-    # -- phase 1: host warm-up to D*m (`nqueens_multigpu_chpl.chpl:173`) ---
-    target = D * m if warmup_target is None else warmup_target
-    tree1, sol1, best = warmup(problem, pool, best, target)
+    # -- phase 1: host warm-up to D*m (`nqueens_multigpu_chpl.chpl:173`),
+    # or checkpoint restore --------------------------------------------------
+    if resume_from is not None:
+        saved = ckpt.load(resume_from, problem)
+        pool.push_back_bulk(saved.batch)
+        tree1, sol1 = saved.tree, saved.sol
+        # Keep the tighter incumbent (cf. resident_search resume).
+        best = min(best, saved.best)
+        # The resumed frontier re-partitions stride-D; grow the per-shard
+        # capacity so the largest shard plus one fan-out fits even when D
+        # shrank since the checkpoint.
+        capacity = max(capacity, -(-pool.size // D) + 2 * M * n)
+    else:
+        pool.push_back(index_batch(problem.root(), 0))
+        target = D * m if warmup_target is None else warmup_target
+        tree1, sol1, best = warmup(problem, pool, best, target)
     t1 = time.perf_counter()
     phases.append(PhaseStats(t1 - t0, tree1, sol1))
 
@@ -397,6 +416,16 @@ def mesh_resident_search(
     per_worker = np.zeros(D, dtype=np.int64)
     prev_sizes = None
     offloader = None
+
+    def snapshot_fn():
+        batch = program.full_batch(state)
+        diagnostics.device_to_host += 1
+        return batch, best
+
+    controller = ckpt.RunController(
+        problem, checkpoint_path, checkpoint_interval_s, max_steps, snapshot_fn
+    )
+
     while True:
         out = program.step(state)
         state, ti, si, cy, sizes, best, tree_vec = program.read_stats(out)
@@ -406,6 +435,19 @@ def mesh_resident_search(
         diagnostics.kernel_launches += cy
         if int(sizes.max()) < m:
             break
+        if controller.after_step(tree1 + tree2, sol1 + sol2):
+            t2 = time.perf_counter()
+            phases.append(PhaseStats(t2 - t1, tree2, sol2))
+            return SearchResult(
+                explored_tree=tree1 + tree2,
+                explored_sol=sol1 + sol2,
+                best=best,
+                elapsed=t2 - t0,
+                phases=phases,
+                diagnostics=diagnostics,
+                per_worker_tree=per_worker.tolist(),
+                complete=False,
+            )
         if cy == 0 and prev_sizes is not None and np.array_equal(sizes, prev_sizes):
             # Saturation: no shard ran a cycle and balancing moved nothing.
             # Fall back to host offload cycles (same guarantee as the
