@@ -56,6 +56,37 @@ def test_lb1_bounds_match_oracle(inst, jobs, machines):
     assert np.array_equal(np.asarray(oracle), np.asarray(got))
 
 
+@pytest.mark.parametrize(
+    "inst,jobs,machines",
+    [(14, 20, 10), (1, 12, 5)],
+)
+def test_lb2_bounds_match_oracle(inst, jobs, machines):
+    rng = np.random.default_rng(11)
+    if jobs == 20:
+        prob = PFSPProblem(inst=inst, lb="lb2", ub=1)
+    else:
+        ptm = taillard.reduced_instance(inst, jobs=jobs, machines=machines)
+        prob = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    B = 200
+    prmu = np.stack([rng.permutation(jobs).astype(np.int32) for _ in range(B)])
+    limit1 = rng.integers(-1, jobs - 1, B).astype(np.int32)
+    oracle = pfsp_device._lb2_chunk(
+        jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads,
+        t.min_tails, t.pairs, t.lags, t.johnson_schedules,
+    )
+    got = pallas_kernels.pfsp_lb2_bounds(
+        jnp.asarray(prmu), jnp.asarray(limit1), t, interpret=True
+    )
+    # Compare only open child slots (k > limit1): closed slots are garbage
+    # by contract (never read by the host/engine).
+    k = np.arange(jobs)[None, :]
+    open_ = k >= limit1[:, None] + 1
+    assert np.array_equal(
+        np.asarray(oracle)[open_], np.asarray(got)[open_]
+    )
+
+
 def test_use_pallas_is_off_on_cpu(monkeypatch):
     monkeypatch.delenv("TTS_PALLAS", raising=False)
     assert pallas_kernels.use_pallas() is False  # tests run on the CPU backend

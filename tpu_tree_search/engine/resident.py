@@ -342,10 +342,7 @@ class _PFSPResident(_ResidentProgram):
             elif lb == "lb1_d":
                 bounds = P._lb1_d_chunk(prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails)
             else:
-                bounds = P._lb2_chunk(
-                    prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails,
-                    t.pairs, t.lags, t.johnson_schedules,
-                )
+                bounds = P.lb2_bounds(prmu_c, limit1_c, t)
             pdepth = limit1_c + 1
             kk = jnp.arange(n, dtype=jnp.int32)[None, :]
             open_ = (kk >= pdepth[:, None]) & valid[:, None]

@@ -105,34 +105,30 @@ def nqueens_labels(board, depth, N: int, g: int = 1, interpret: bool = False):
 # ---------------------------------------------------------------------------
 
 
-def _lb1_kernel(
-    prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, *, n: int, m: int
-):
-    """Full lb1 bound of every child of every parent in the tile.
-
-    Math identical to `ops/pfsp_device._lb1_chunk` (itself the batched form
-    of `c_bound_simple.c:51-141` + one incremental `add_forward` per child);
-    here the whole chain runs on one VMEM tile: one-hot MXU gather of the
-    per-position processing times, the O(n) schedule_front scan, the O(m)
-    child update, and the machine-bound max chain.
-    """
-    prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
-    limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
-    ptm = ptm_ref[:].astype(jnp.float32)  # (n, m) job-major
-    T = prmu.shape[0]
-
-    # ptg[b, i, :] = ptm[prmu[b, i]] via one-hot matmul (exact: ints < 2^24).
-    jobs_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n, n), 2)
-    onehot = (jobs_iota == prmu[:, :, None]).astype(jnp.float32)
-    ptg = jax.lax.dot_general(
-        onehot.reshape(T * n, n),
-        ptm,
+def _hp_dot(a, b):
+    """f32 MXU matmul at HIGHEST precision (the default single bf16 pass
+    rounds ints > 256)."""
+    return jax.lax.dot_general(
+        a, b,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,  # MXU default bf16 pass rounds ints > 256
-    ).reshape(T, n, m).astype(jnp.int32)
+        precision=jax.lax.Precision.HIGHEST,
+    )
 
-    # schedule_front(prmu, limit1): n-step scan, masked per row.
+
+def _tile_parent_state(prmu, limit1, ptm, heads, n: int, m: int):
+    """Shared tile prologue of the PFSP bound kernels: the one-hot MXU gather
+    of per-position processing times, the masked schedule_front scan
+    (`c_bound_simple.c:51-69`), and the per-child add_forward fronts.
+
+    Returns (onehot, ptg, front, child_front_cols) with child_front_cols a
+    list of m (T, n) columns.
+    """
+    T = prmu.shape[0]
+    jobs_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n, n), 2)
+    onehot = (jobs_iota == prmu[:, :, None]).astype(jnp.float32)
+    ptg = _hp_dot(onehot.reshape(T * n, n), ptm).reshape(T, n, m).astype(jnp.int32)
+
     front = jnp.zeros((T, m), jnp.int32)
 
     def scan_step(i, front):
@@ -144,7 +140,31 @@ def _lb1_kernel(
         return jnp.where((i <= limit1)[:, None], newf, front)
 
     front = jax.lax.fori_loop(0, n, scan_step, front)
-    front = jnp.where((limit1 == -1)[:, None], heads_ref[:], front)
+    front = jnp.where((limit1 == -1)[:, None], heads, front)
+
+    f = front[:, None, :]  # (T, 1, m)
+    child_front = [f[..., 0] + ptg[..., 0]]
+    for j in range(1, m):
+        child_front.append(jnp.maximum(child_front[-1], f[..., j]) + ptg[..., j])
+    return onehot, ptg, front, child_front
+
+
+def _lb1_kernel(
+    prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, *, n: int, m: int
+):
+    """Full lb1 bound of every child of every parent in the tile.
+
+    Math identical to `ops/pfsp_device._lb1_chunk` (itself the batched form
+    of `c_bound_simple.c:51-141` + one incremental `add_forward` per child);
+    here the whole chain runs on one VMEM tile.
+    """
+    prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
+    limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
+    ptm = ptm_ref[:].astype(jnp.float32)  # (n, m) job-major
+    T = prmu.shape[0]
+    _, ptg, _, child_front = _tile_parent_state(
+        prmu, limit1, ptm, heads_ref[:], n, m
+    )
 
     # remaining work per machine after removing the child job.
     unsched = (
@@ -152,13 +172,8 @@ def _lb1_kernel(
     ).astype(jnp.int32)
     remain = jnp.sum(ptg * unsched[:, :, None], axis=1)  # (T, m)
 
-    # Child k: one add_forward step + machine bound chain, unrolled over m.
+    # Child k: machine bound chain, unrolled over m.
     tails = tails_ref[:][0]  # (m,)
-    f = front[:, None, :]  # (T, 1, m)
-    cf0 = f[..., 0] + ptg[..., 0]  # child front, machine 0: (T, n)
-    child_front = [cf0]
-    for j in range(1, m):
-        child_front.append(jnp.maximum(child_front[-1], f[..., j]) + ptg[..., j])
     cremain = remain[:, None, :] - ptg  # (T, n, m)
     tmp0 = child_front[0] + cremain[..., 0]
     lb = tmp0 + tails[0]
@@ -187,6 +202,129 @@ def _lb1_call(n: int, m: int, B: int, tile: int, interpret: bool):
         out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
         interpret=interpret,
     )
+
+
+def _lb2_kernel(
+    prmu_ref, limit1_ref, ptm_ref, heads_ref,
+    p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, ma0_ref, ma1_ref, jorder_ref,
+    out_ref, *, n: int, m: int, P: int,
+):
+    """Full lb2 (two-machine Johnson) bound of every child in the tile.
+
+    Math identical to `ops/pfsp_device._lb2_chunk` (the closed-form max-plus
+    scan of `c_bound_johnson.c:190-234`, early exit dropped — see that
+    module's docstring). The decisive difference from the jnp path: the
+    whole pair loop runs against VMEM-resident tile state (child fronts,
+    free-job flags, the Johnson-ordered tables), so the ~P x (B, n, n)
+    intermediates never touch HBM.
+    """
+    prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
+    limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
+    ptm = ptm_ref[:].astype(jnp.float32)  # (n, m)
+    T = prmu.shape[0]
+    hp = _hp_dot
+    onehot, _, _, cf = _tile_parent_state(prmu, limit1, ptm, heads_ref[:], n, m)
+    child_front = jnp.stack(cf, axis=-1).astype(jnp.float32)  # (T, n, m)
+
+    # Free-job flags by job id: parent's open positions minus the child job.
+    slot_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n), 1)
+    unsched = (slot_iota >= (limit1 + 1)[:, None]).astype(jnp.float32)  # (T, n)
+    u_parent = jnp.sum(onehot * unsched[:, :, None], axis=1)  # (T, n) by job
+    u_child = u_parent[:, None, :] - onehot  # (T, k, job)
+
+    neg = jnp.float32(-(2.0**30))
+    # Prefix/suffix sums along the ordered-slot axis as triangular matmuls
+    # (MXU work; Mosaic has no native lane-axis cumsum).
+    ri = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    tri_incl = (ri <= ci).astype(jnp.float32)  # prefix: sum_{s<=t}
+    tri_suf = (ri >= ci).astype(jnp.float32)  # suffix: sum_{s>=t}
+
+    def pair_body(q, lb):
+        jord = jorder_ref[q]  # (n, n) slot-order one-hot
+        # u_o[b, k, t] = u_child[b, k, sched_q[t]]
+        u_o = hp(u_child.reshape(T * n, n), jord.T).reshape(T, n, n)
+        p0 = p0_ref[q].astype(jnp.float32)  # (n,)
+        p1 = p1_ref[q].astype(jnp.float32)
+        lag = lag_ref[q].astype(jnp.float32)
+        mp0 = u_o * p0[None, None, :]
+        mp1 = u_o * p1[None, None, :]
+        ma0 = ma0_ref[q]
+        ma1 = ma1_ref[q]
+        tmp0_0 = jax.lax.dynamic_slice_in_dim(child_front, ma0, 1, axis=2)[..., 0]
+        tmp1_0 = jax.lax.dynamic_slice_in_dim(child_front, ma1, 1, axis=2)[..., 0]
+        cum0 = hp(mp0.reshape(T * n, n), tri_incl).reshape(T, n, n)
+        suf1 = hp(mp1.reshape(T * n, n), tri_suf).reshape(T, n, n)
+        t0 = tmp0_0[:, :, None] + cum0
+        a = jnp.where(u_o > 0, t0 + lag[None, None, :] + suf1, neg)
+        tmp1 = jnp.maximum(tmp1_0 + jnp.sum(mp1, axis=-1), jnp.max(a, axis=-1))
+        tmp0 = tmp0_0 + jnp.sum(mp0, axis=-1)
+        pair_lb = jnp.maximum(
+            tmp1 + t1_ref[q].astype(jnp.float32),
+            tmp0 + t0_ref[q].astype(jnp.float32),
+        )
+        return jnp.maximum(lb, pair_lb)
+
+    lb = jax.lax.fori_loop(0, P, pair_body, jnp.zeros((T, n), jnp.float32))
+    out_ref[:] = lb.astype(jnp.int32)
+
+
+@lru_cache(maxsize=None)
+def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool):
+    kernel = partial(_lb2_kernel, n=n, m=m, P=P)
+    grid = (B // tile,)
+    full = lambda i: (0, 0)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, n), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, n), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, n), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, n), full, memory_space=pltpu.VMEM),
+            # Per-pair scalars read with a dynamic index: SMEM (Mosaic cannot
+            # dynamically index 1-D VMEM along the lane dim).
+            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((P, n, n), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+
+
+def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False):
+    """(B, n) int32 lb2 child bounds; same contract as `_lb2_chunk`."""
+    B, n = prmu.shape
+    m = tables.ptm_t.shape[1]
+    P = tables.pairs.shape[0]
+    tile = min(128, B)
+    Bp = _round_up(B, tile)
+    if Bp != B:
+        prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
+        limit1 = jnp.pad(limit1, ((0, Bp - B),))
+    ordered = tables.johnson_ordered()
+    out = _lb2_call(n, m, P, Bp, tile, interpret)(
+        prmu.astype(jnp.int32),
+        limit1.astype(jnp.int32)[:, None],
+        tables.ptm_t,
+        tables.min_heads[None, :],
+        ordered.p0_o,
+        ordered.p1_o,
+        ordered.lag_o,
+        ordered.tails0,
+        ordered.tails1,
+        tables.pairs[:, 0],
+        tables.pairs[:, 1],
+        ordered.jorder,
+    )
+    return out[:B]
 
 
 def pfsp_lb1_bounds(

@@ -228,6 +228,13 @@ def _lb2_chunk(
 class PFSPDeviceTables:
     """Instance tables placed on device once per search
     (`pfsp_gpu_chpl.chpl:362-371`: device-resident lbound1/lbound2 copies).
+
+    ``johnson_ordered()`` additionally derives the Johnson data in *schedule
+    order* per machine pair (`p0_o/p1_o/lag_o[q, t]` = value of the t-th job
+    of pair q's Johnson schedule) plus a per-pair permutation one-hot, so the
+    Pallas lb2 kernel reorders the per-child free-job flags with one small
+    matmul instead of a runtime gather chain. Built lazily: the dense
+    (P, n, n) one-hot is only worth its memory when that kernel runs.
     """
 
     def __init__(self, lb1_data, lb2_data):
@@ -237,6 +244,31 @@ class PFSPDeviceTables:
         self.pairs = jnp.asarray(lb2_data.pairs, dtype=jnp.int32)
         self.lags = jnp.asarray(lb2_data.lags, dtype=jnp.int32)
         self.johnson_schedules = jnp.asarray(lb2_data.johnson_schedules, dtype=jnp.int32)
+
+    def johnson_ordered(self):
+        if not hasattr(self, "_johnson_ordered"):
+            ptm = np.asarray(self.ptm_t).T  # (m, n)
+            pairs = np.asarray(self.pairs)  # (P, 2)
+            lags = np.asarray(self.lags)  # (P, n)
+            sched = np.asarray(self.johnson_schedules)  # (P, n) job ids
+            P, n = sched.shape
+            rows = np.arange(P)[:, None]
+            tails = np.asarray(self.min_tails)
+            jorder = np.zeros((P, n, n), dtype=np.float32)
+            jorder[rows, np.arange(n)[None, :], sched] = 1.0
+
+            class _Ordered:
+                pass
+
+            o = _Ordered()
+            o.p0_o = jnp.asarray(ptm[pairs[:, 0][:, None], sched], dtype=jnp.int32)
+            o.p1_o = jnp.asarray(ptm[pairs[:, 1][:, None], sched], dtype=jnp.int32)
+            o.lag_o = jnp.asarray(lags[rows, sched], dtype=jnp.int32)
+            o.tails0 = jnp.asarray(tails[pairs[:, 0]], dtype=jnp.int32)
+            o.tails1 = jnp.asarray(tails[pairs[:, 1]], dtype=jnp.int32)
+            o.jorder = jnp.asarray(jorder)
+            self._johnson_ordered = o
+        return self._johnson_ordered
 
 
 def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables"):
@@ -251,6 +283,20 @@ def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables"):
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails
         )
     return _lb1_chunk(prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails)
+
+
+def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables"):
+    """lb2 chunk bounds, routed like ``lb1_bounds``. The Pallas kernel keeps
+    the whole Johnson pair loop in VMEM — the jnp path's per-pair (B, n, n)
+    intermediates round-trip HBM, which dominates its cost."""
+    from . import pallas_kernels as PK
+
+    if PK.use_pallas() and prmu.shape[-1] <= 32:
+        return PK.pfsp_lb2_bounds(prmu, limit1, tables)
+    return _lb2_chunk(
+        prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
+        tables.pairs, tables.lags, tables.johnson_schedules,
+    )
 
 
 def make_evaluator(tables: PFSPDeviceTables, lb: str):
@@ -272,11 +318,7 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str):
     elif lb == "lb2":
         def evaluate(parents, count, best):
             del count, best
-            return _lb2_chunk(
-                parents["prmu"], parents["limit1"], tables.ptm_t,
-                tables.min_heads, tables.min_tails,
-                tables.pairs, tables.lags, tables.johnson_schedules,
-            )
+            return lb2_bounds(parents["prmu"], parents["limit1"], tables)
     else:
         raise ValueError(f"Unsupported lower bound: {lb!r}")
     return evaluate
