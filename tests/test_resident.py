@@ -57,6 +57,16 @@ def test_pfsp_fixed_incumbent_parity(lb):
     )
 
 
+def test_large_instance_shapes():
+    # 50-job instance (gather fallback path, int8 pool rows): a prune-all
+    # incumbent keeps the tree tiny so this only checks shapes/dtypes.
+    ptm = taillard.reduced_instance(31, jobs=50, machines=10)
+    prob = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    res = resident_search(prob, m=8, M=128, K=8, initial_best=1)
+    assert res.complete
+    assert res.best == 1  # nothing can beat a makespan of 1
+
+
 @pytest.mark.parametrize("lb", ["lb1", "lb2"])
 def test_pfsp_improving_incumbent_finds_optimum(lb):
     ptm = taillard.reduced_instance(7, jobs=9, machines=6)

@@ -67,6 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_argument("--max-steps", type=int, default=None,
                         help="stop after this many device dispatches "
                         "(checkpointing cutoff; result is marked incomplete)")
+    common.add_argument("--perc", type=float, default=0.5,
+                        help="multi tier: fraction of a victim's pool front "
+                        "taken per steal (the CUDA baseline's --perc; 0.5 = "
+                        "the steal-half policy)")
+    common.add_argument("--profile", type=str, default=None,
+                        help="write a jax profiler trace of the search to "
+                        "this directory (view with TensorBoard/XProf)")
 
     nq = sub.add_parser("nqueens", parents=[common], help="N-Queens backtracking")
     nq.add_argument("--N", type=int, default=14, help="number of queens")
@@ -136,7 +143,9 @@ def run_tier(problem, args):
     if args.tier == "multi":
         from .parallel.multidevice import multidevice_search
 
-        return multidevice_search(problem, m=args.m, M=args.M, D=args.D)
+        return multidevice_search(
+            problem, m=args.m, M=args.M, D=args.D, perc=args.perc
+        )
     from .parallel.dist import dist_search
 
     return dist_search(problem, m=args.m, M=args.M, D=args.D)
@@ -250,7 +259,15 @@ def main(argv=None) -> int:
         return 2
     print_settings(args)
     try:
-        res = run_tier(problem, args)
+        if args.profile:
+            # Trace the whole search (phase timers remain the first-class
+            # report, SURVEY.md §5 tracing; this adds the XLA-level view).
+            import jax
+
+            with jax.profiler.trace(args.profile):
+                res = run_tier(problem, args)
+        else:
+            res = run_tier(problem, args)
     except (ModuleNotFoundError, NotImplementedError) as e:
         print(f"Error: tier '{args.tier}' unavailable: {e}", file=sys.stderr)
         return 2

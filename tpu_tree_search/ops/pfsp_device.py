@@ -75,10 +75,10 @@ def gather_ptimes(prmu, ptm_t):
     the MXU evaluates it far faster than TPU dynamic gathers, and it is exact
     (one-hot rows select a single int value, and ints < 2^24 are exact in
     f32). Larger instances fall back to the gather (the (B, n, n) one-hot
-    would dominate memory).
+    would dominate memory: at n=50 and a 64k chunk it is already ~650 MB).
     """
     n = prmu.shape[-1]
-    if n <= 64:
+    if n <= 32:
         oh = jax.nn.one_hot(prmu, n, dtype=jnp.float32)  # (B, n, n)
         return jnp.einsum(
             "bkj,jm->bkm", oh, ptm_t.astype(jnp.float32),

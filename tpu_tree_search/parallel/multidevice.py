@@ -90,6 +90,7 @@ def _worker_loop(
     M: int,
     shared: _SharedBest | None,
     rng: np.random.Generator,
+    perc: float = 0.5,
 ):
     problem = w.problem
     try:
@@ -125,7 +126,7 @@ def _worker_loop(
                 for _ in range(10):  # lock attempts cap, `Pool_par` call sites
                     if victim.try_lock():
                         try:
-                            batch = victim.pop_front_bulk_half(m)
+                            batch = victim.pop_front_bulk_half(m, perc)
                         finally:
                             victim.unlock()
                         if batch is not None:
@@ -160,6 +161,7 @@ def run_workers(
     best: int,
     share_bound: bool = True,
     seed: int = 0xB0B,
+    perc: float = 0.5,
 ):
     """Step 2 of the multi-device tier: partition ``pool`` across D worker
     threads, run the offload/steal/terminate loops, join, and merge leftovers
@@ -180,7 +182,7 @@ def run_workers(
     threads = [
         threading.Thread(
             target=_worker_loop,
-            args=(w, pools, states, m, M, shared, np.random.default_rng(s)),
+            args=(w, pools, states, m, M, shared, np.random.default_rng(s), perc),
             name=f"tts-worker-{w.wid}",
         )
         for w, s in zip(workers, seeds.spawn(D))
@@ -212,6 +214,7 @@ def host_pipeline(
     num_hosts: int = 1,
     host_id: int = 0,
     seed: int = 0xB0B,
+    perc: float = 0.5,
 ) -> dict:
     """The full 3-phase pipeline one host runs: warm-up, partitioned
     parallel offload (work stealing + termination), drain.
@@ -251,7 +254,7 @@ def host_pipeline(
 
     # -- step 2: partitioned parallel offload ------------------------------
     pool, tree2, sol2, best, workers = run_workers(
-        problem, pool, D, assigned, m, M, best, share_bound, seed=seed
+        problem, pool, D, assigned, m, M, best, share_bound, seed=seed, perc=perc
     )
     t2 = time.perf_counter()
 
@@ -287,6 +290,7 @@ def multidevice_search(
     devices=None,
     initial_best: int | None = None,
     share_bound: bool = True,
+    perc: float = 0.5,
 ) -> SearchResult:
     import jax
 
@@ -295,7 +299,7 @@ def multidevice_search(
     if D is None:
         D = len(devices)
     local = host_pipeline(
-        problem, m, M, D, devices, initial_best, share_bound
+        problem, m, M, D, devices, initial_best, share_bound, perc=perc
     )
     return SearchResult(
         explored_tree=local["tree"],

@@ -134,14 +134,17 @@ class SoAPool:
             out[name][:k] = arr[start : start + k]
         return k
 
-    def pop_front_bulk_half(self, m: int) -> dict | None:
-        """Steal half the pool from the *front* (oldest, shallowest subtrees)
-        iff size >= 2m; the steal-half policy of `Pool_par.chpl:180-191`.
-        Returns a batch or None.
+    def pop_front_bulk_half(self, m: int, perc: float = 0.5) -> dict | None:
+        """Steal a ``perc`` fraction of the pool from the *front* (oldest,
+        shallowest subtrees) iff size >= 2m. perc=0.5 is the steal-half
+        policy of `Pool_par.chpl:180-191`; other fractions mirror the CUDA
+        baseline's `--perc` knob (`Pool_ext.c:138-151`). Returns a batch or
+        None.
         """
         if self.size < 2 * m:
             return None
-        k = self.size // 2
+        k = max(1, int(self.size * perc))
+        k = min(k, self.size)
         batch = {
             name: arr[self.front : self.front + k].copy()
             for name, arr in self.data.items()
