@@ -13,14 +13,20 @@ too, before any backend initializes.
 
 import os
 
-os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable TPU plugin registration
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# TTS_TPU_TESTS=1 skips the CPU pin so the hardware gate
+# (tests/test_tpu_smoke.py) can compile the Pallas kernels on a real chip
+# (`TTS_TPU_TESTS=1 pytest tests/test_tpu_smoke.py`). The rest of the suite
+# is CPU-oriented: tests needing the virtual 8-device platform skip
+# themselves when fewer devices exist.
+if os.environ.get("TTS_TPU_TESTS", "0") != "1":
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable TPU plugin registration
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

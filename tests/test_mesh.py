@@ -3,10 +3,17 @@ bounds must match the unsharded evaluators bit-exactly, the lb2 machine-pair
 (mp) sharding must be transparent, and the in-step incumbent fold must
 respect the valid-row count."""
 
+import jax
 import numpy as np
 import pytest
 
 from tpu_tree_search.parallel import mesh as M
+
+# These tests need the virtual 8-device platform; a real-TPU run
+# (TTS_TPU_TESTS=1) typically has fewer chips.
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 devices (virtual CPU platform)"
+)
 from tpu_tree_search.problems import NQueensProblem, PFSPProblem
 from tpu_tree_search.problems.pfsp import taillard as T
 

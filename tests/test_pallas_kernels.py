@@ -88,5 +88,18 @@ def test_lb2_bounds_match_oracle(inst, jobs, machines):
 
 
 def test_use_pallas_is_off_on_cpu(monkeypatch):
+    import jax
+
+    if jax.default_backend() == "tpu":
+        pytest.skip("suite running on a real TPU backend (TTS_TPU_TESTS=1)")
     monkeypatch.delenv("TTS_PALLAS", raising=False)
     assert pallas_kernels.use_pallas() is False  # tests run on the CPU backend
+
+
+def test_use_pallas_routes_per_device():
+    """A CPU target device must never route to Pallas, whatever the default
+    backend is (the round-2 dryrun failure mode)."""
+    import jax
+
+    cpus = jax.devices("cpu")
+    assert pallas_kernels.use_pallas(cpus[0]) is False

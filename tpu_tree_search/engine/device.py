@@ -90,7 +90,7 @@ class DeviceOffloader:
 
         self.problem = problem
         self.device = device if device is not None else jax.devices()[0]
-        self._evaluate = problem.make_device_evaluator()
+        self._evaluate = problem.make_device_evaluator(self.device)
         self.diagnostics = Diagnostics()
 
     def dispatch(self, parents_np: dict, count: int, bucket: int, best: int):

@@ -335,14 +335,15 @@ class _PFSPResident(_ResidentProgram):
             prob._device_tables = t
         lb = prob.lb
         n = prob.jobs
+        device = self.device
 
         def evaluate(prmu_c, limit1_c, valid, best):
             if lb == "lb1":
-                bounds = P.lb1_bounds(prmu_c, limit1_c, t)
+                bounds = P.lb1_bounds(prmu_c, limit1_c, t, device)
             elif lb == "lb1_d":
                 bounds = P._lb1_d_chunk(prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails)
             else:
-                bounds = P.lb2_bounds(prmu_c, limit1_c, t)
+                bounds = P.lb2_bounds(prmu_c, limit1_c, t, device)
             pdepth = limit1_c + 1
             kk = jnp.arange(n, dtype=jnp.int32)[None, :]
             open_ = (kk >= pdepth[:, None]) & valid[:, None]
@@ -384,7 +385,7 @@ class _NQueensResident(_ResidentProgram):
         from ..ops import nqueens_device
 
         N = self.problem.N
-        core = nqueens_device.make_labels(N, self.problem.g)
+        core = nqueens_device.make_labels(N, self.problem.g, self.device)
 
         def evaluate(board_c, depth_c, valid, best):
             # A popped node at depth == N is a solution (`nqueens_chpl.chpl:74`).

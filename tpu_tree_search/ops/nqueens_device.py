@@ -49,19 +49,20 @@ def make_core(N: int, g: int = 1):
     return core
 
 
-def make_labels(N: int, g: int = 1):
-    """Routed safety evaluator: Pallas kernel on TPU (`pallas_kernels.py`),
-    the jnp/XLA core elsewhere. Same contract as ``make_core``."""
+def make_labels(N: int, g: int = 1, device=None):
+    """Routed safety evaluator: Pallas kernel when the target device is a
+    TPU (`pallas_kernels.py`), the jnp/XLA core elsewhere. Same contract as
+    ``make_core``."""
     from . import pallas_kernels as PK
 
-    if PK.use_pallas():
+    if PK.use_pallas(device):
         return lambda board, depth: PK.nqueens_labels(board, depth, N, g)
     return make_core(N, g)
 
 
 @lru_cache(maxsize=None)
-def make_jitted_core(N: int, g: int = 1):
-    """Module-level jit cache keyed on (N, g): every DeviceOffloader / worker
-    thread shares one compiled kernel per bucket shape instead of re-tracing
-    per closure (cf. the module-level jitted PFSP chunk kernels)."""
-    return jax.jit(make_labels(N, g))
+def make_jitted_core(N: int, g: int = 1, device=None):
+    """Module-level jit cache keyed on (N, g, device): every DeviceOffloader
+    / worker thread shares one compiled kernel per bucket shape instead of
+    re-tracing per closure (cf. the module-level jitted PFSP chunk kernels)."""
+    return jax.jit(make_labels(N, g, device))

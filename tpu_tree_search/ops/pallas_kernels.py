@@ -29,10 +29,20 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def use_pallas() -> bool:
+def use_pallas(device=None) -> bool:
+    """Route to the Pallas kernels only when the *target device* is a TPU.
+
+    The reference's dispatcher selects per device context
+    (`evaluate.cu:93-119`); keying on the process default backend instead
+    breaks any CPU-device execution inside a TPU-default process (e.g. the
+    driver's virtual-CPU multichip dryrun). Callers that own a device thread
+    it through; ``None`` falls back to the default backend.
+    """
     if os.environ.get("TTS_PALLAS", "1") == "0":
         return False
     try:
+        if device is not None:
+            return device.platform == "tpu"
         return jax.default_backend() == "tpu"
     except Exception:
         return False
@@ -116,10 +126,15 @@ def _hp_dot(a, b):
     )
 
 
-def _tile_parent_state(prmu, limit1, ptm, heads, n: int, m: int):
+def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int):
     """Shared tile prologue of the PFSP bound kernels: the one-hot MXU gather
     of per-position processing times, the masked schedule_front scan
     (`c_bound_simple.c:51-69`), and the per-child add_forward fronts.
+
+    ``scan_ref`` is an (n, T, m) VMEM scratch: Mosaic cannot dynamic_slice a
+    *value* with the traced loop index, but it can dynamically index a Ref on
+    its leading axis — so the scan's per-position processing times are staged
+    there (position-major) and the fori_loop reads ``scan_ref[i]``.
 
     Returns (onehot, ptg, front, child_front_cols) with child_front_cols a
     list of m (T, n) columns.
@@ -129,17 +144,23 @@ def _tile_parent_state(prmu, limit1, ptm, heads, n: int, m: int):
     onehot = (jobs_iota == prmu[:, :, None]).astype(jnp.float32)
     ptg = _hp_dot(onehot.reshape(T * n, n), ptm).reshape(T, n, m).astype(jnp.int32)
 
-    front = jnp.zeros((T, m), jnp.int32)
+    # Position-major copy for the scan (same one-hot trick, rows swapped so
+    # the reshape lands (n, T, m) without a 3-D transpose).
+    iota_nT = jax.lax.broadcasted_iota(jnp.int32, (n, T, n), 2)
+    oh_nT = (iota_nT == prmu.T[:, :, None]).astype(jnp.float32)
+    scan_ref[...] = (
+        _hp_dot(oh_nT.reshape(n * T, n), ptm).reshape(n, T, m).astype(jnp.int32)
+    )
 
     def scan_step(i, front):
-        pt = ptg[:, i, :]
+        pt = scan_ref[i]  # (T, m) — dynamic leading-axis ref read
         cols = [front[:, 0] + pt[:, 0]]
         for j in range(1, m):
             cols.append(jnp.maximum(cols[-1], front[:, j]) + pt[:, j])
         newf = jnp.stack(cols, axis=-1)
         return jnp.where((i <= limit1)[:, None], newf, front)
 
-    front = jax.lax.fori_loop(0, n, scan_step, front)
+    front = jax.lax.fori_loop(0, n, scan_step, jnp.zeros((T, m), jnp.int32))
     front = jnp.where((limit1 == -1)[:, None], heads, front)
 
     f = front[:, None, :]  # (T, 1, m)
@@ -150,7 +171,8 @@ def _tile_parent_state(prmu, limit1, ptm, heads, n: int, m: int):
 
 
 def _lb1_kernel(
-    prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, *, n: int, m: int
+    prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, scan_ref,
+    *, n: int, m: int
 ):
     """Full lb1 bound of every child of every parent in the tile.
 
@@ -163,7 +185,7 @@ def _lb1_kernel(
     ptm = ptm_ref[:].astype(jnp.float32)  # (n, m) job-major
     T = prmu.shape[0]
     _, ptg, _, child_front = _tile_parent_state(
-        prmu, limit1, ptm, heads_ref[:], n, m
+        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m
     )
 
     # remaining work per machine after removing the child job.
@@ -200,14 +222,15 @@ def _lb1_call(n: int, m: int, B: int, tile: int, interpret: bool):
             pl.BlockSpec((1, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
         interpret=interpret,
     )
 
 
 def _lb2_kernel(
     prmu_ref, limit1_ref, ptm_ref, heads_ref,
-    p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, ma0_ref, ma1_ref, jorder_ref,
-    out_ref, *, n: int, m: int, P: int,
+    p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
+    out_ref, scan_ref, *, n: int, m: int, P: int,
 ):
     """Full lb2 (two-machine Johnson) bound of every child in the tile.
 
@@ -223,7 +246,9 @@ def _lb2_kernel(
     ptm = ptm_ref[:].astype(jnp.float32)  # (n, m)
     T = prmu.shape[0]
     hp = _hp_dot
-    onehot, _, _, cf = _tile_parent_state(prmu, limit1, ptm, heads_ref[:], n, m)
+    onehot, _, _, cf = _tile_parent_state(
+        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m
+    )
     child_front = jnp.stack(cf, axis=-1).astype(jnp.float32)  # (T, n, m)
 
     # Free-job flags by job id: parent's open positions minus the child job.
@@ -249,10 +274,14 @@ def _lb2_kernel(
         lag = lag_ref[q].astype(jnp.float32)
         mp0 = u_o * p0[None, None, :]
         mp1 = u_o * p1[None, None, :]
-        ma0 = ma0_ref[q]
-        ma1 = ma1_ref[q]
-        tmp0_0 = jax.lax.dynamic_slice_in_dim(child_front, ma0, 1, axis=2)[..., 0]
-        tmp1_0 = jax.lax.dynamic_slice_in_dim(child_front, ma1, 1, axis=2)[..., 0]
+        # Machine selection as a one-hot contraction on the lane axis —
+        # Mosaic cannot dynamic_slice a VMEM *value* along a lane dim, but a
+        # masked reduction against the precomputed (P, m) selector rows is
+        # exact (0/1 mask) and pure VPU work.
+        s0 = msel0_ref[q].astype(jnp.float32)  # (m,)
+        s1 = msel1_ref[q].astype(jnp.float32)
+        tmp0_0 = jnp.sum(child_front * s0[None, None, :], axis=-1)  # (T, n)
+        tmp1_0 = jnp.sum(child_front * s1[None, None, :], axis=-1)
         cum0 = hp(mp0.reshape(T * n, n), tri_incl).reshape(T, n, n)
         suf1 = hp(mp1.reshape(T * n, n), tri_suf).reshape(T, n, n)
         t0 = tmp0_0[:, :, None] + cum0
@@ -290,11 +319,13 @@ def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool):
             # dynamically index 1-D VMEM along the lane dim).
             pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
             pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            # (P, m) one-hot machine selectors (rows read per pair).
+            pl.BlockSpec((P, m), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, m), full, memory_space=pltpu.VMEM),
             pl.BlockSpec((P, n, n), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
         interpret=interpret,
     )
 
@@ -320,8 +351,8 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False):
         ordered.lag_o,
         ordered.tails0,
         ordered.tails1,
-        tables.pairs[:, 0],
-        tables.pairs[:, 1],
+        ordered.msel0,
+        ordered.msel1,
         ordered.jorder,
     )
     return out[:B]

@@ -267,31 +267,40 @@ class PFSPDeviceTables:
             o.tails0 = jnp.asarray(tails[pairs[:, 0]], dtype=jnp.int32)
             o.tails1 = jnp.asarray(tails[pairs[:, 1]], dtype=jnp.int32)
             o.jorder = jnp.asarray(jorder)
+            # (P, m) one-hot machine selectors: the Pallas kernel reads row q
+            # and contracts it against the child fronts instead of dynamically
+            # slicing a VMEM value along the machine (lane) axis.
+            m = ptm.shape[0]
+            eye = np.eye(m, dtype=np.float32)
+            o.msel0 = jnp.asarray(eye[pairs[:, 0]])
+            o.msel1 = jnp.asarray(eye[pairs[:, 1]])
             self._johnson_ordered = o
         return self._johnson_ordered
 
 
-def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables"):
-    """lb1 chunk bounds, routed: Pallas kernel on TPU (VMEM-resident tile
-    pass, `ops/pallas_kernels.py`), the jnp/XLA oracle elsewhere."""
+def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
+    """lb1 chunk bounds, routed per target device: Pallas kernel on TPU
+    (VMEM-resident tile pass, `ops/pallas_kernels.py`), the jnp/XLA oracle
+    elsewhere (cf. the reference's per-device dispatcher,
+    `evaluate.cu:93-119`)."""
     from . import pallas_kernels as PK
 
     # Same n-gate as gather_ptimes: the kernel's (tile, n, n) one-hot stays
     # within VMEM only for small job counts; large instances use the oracle.
-    if PK.use_pallas() and prmu.shape[-1] <= 64:
+    if PK.use_pallas(device) and prmu.shape[-1] <= 64:
         return PK.pfsp_lb1_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails
         )
     return _lb1_chunk(prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails)
 
 
-def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables"):
+def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     """lb2 chunk bounds, routed like ``lb1_bounds``. The Pallas kernel keeps
     the whole Johnson pair loop in VMEM — the jnp path's per-pair (B, n, n)
     intermediates round-trip HBM, which dominates its cost."""
     from . import pallas_kernels as PK
 
-    if PK.use_pallas() and prmu.shape[-1] <= 32:
+    if PK.use_pallas(device) and prmu.shape[-1] <= 32:
         return PK.pfsp_lb2_bounds(prmu, limit1, tables)
     return _lb2_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
@@ -299,15 +308,16 @@ def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables"):
     )
 
 
-def make_evaluator(tables: PFSPDeviceTables, lb: str):
+def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):
     """Dispatcher over the three bounds (`pfsp_gpu_chpl.chpl:256-270`).
 
-    Returns ``fn(parents: dict, count, best) -> (B, jobs) int32 bounds``.
+    Returns ``fn(parents: dict, count, best) -> (B, jobs) int32 bounds``;
+    ``device`` selects the Pallas-vs-XLA path per target platform.
     """
     if lb == "lb1":
         def evaluate(parents, count, best):
             del count, best
-            return lb1_bounds(parents["prmu"], parents["limit1"], tables)
+            return lb1_bounds(parents["prmu"], parents["limit1"], tables, device)
     elif lb == "lb1_d":
         def evaluate(parents, count, best):
             del count, best
@@ -318,7 +328,7 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str):
     elif lb == "lb2":
         def evaluate(parents, count, best):
             del count, best
-            return lb2_bounds(parents["prmu"], parents["limit1"], tables)
+            return lb2_bounds(parents["prmu"], parents["limit1"], tables, device)
     else:
         raise ValueError(f"Unsupported lower bound: {lb!r}")
     return evaluate

@@ -76,9 +76,11 @@ class _MeshResidentProgram:
         self.T = T
         self.capacity = capacity
         # Single-device program supplies the pool schema, hooks, and the
-        # K-cycle loop body; its own jitted step is unused here.
+        # K-cycle loop body; its own jitted step is unused here. Built for
+        # the mesh's device platform so the kernel routing (Pallas on TPU,
+        # XLA elsewhere) matches where the shards actually run.
         self.inner = _make_program(
-            problem, m, M, K, capacity, jax.devices()[0]
+            problem, m, M, K, capacity, mesh.devices.flat[0]
         )
         self._build()
 
@@ -457,7 +459,7 @@ def mesh_resident_search(
             pool.reset_from(program.full_batch(state))
             diagnostics.device_to_host += 1
             if offloader is None:
-                offloader = DeviceOffloader(problem, jax.devices()[0])
+                offloader = DeviceOffloader(problem, program.mesh.devices.flat[0])
             chunk_buf = problem.empty_batch(M)
             fits = D * max(0, capacity - 2 * M * n)
             while pool.size >= m and pool.size > fits:

@@ -63,10 +63,11 @@ class Problem:
 
     # -- offload tier ------------------------------------------------------
 
-    def make_device_evaluator(self):
+    def make_device_evaluator(self, device=None):
         """Returns a jit-compiled ``fn(parents: dict[str, jnp], count, best)
         -> results`` evaluating all children of a padded chunk. ``results``
-        has shape (capacity, child_slots).
+        has shape (capacity, child_slots). ``device`` (optional) is the
+        target device, used to route hand-written kernels per platform.
         """
         raise NotImplementedError
 

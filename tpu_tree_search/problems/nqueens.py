@@ -107,10 +107,10 @@ class NQueensProblem(Problem):
 
     # -- device path -------------------------------------------------------
 
-    def make_device_evaluator(self):
+    def make_device_evaluator(self, device=None):
         from ..ops import nqueens_device
 
-        core = nqueens_device.make_jitted_core(self.N, self.g)
+        core = nqueens_device.make_jitted_core(self.N, self.g, device)
 
         def evaluate(parents, count, best):
             """Batched safety labels, one slot per (parent, candidate column)

@@ -172,7 +172,7 @@ class PFSPProblem(Problem):
 
     # -- device path -------------------------------------------------------
 
-    def make_device_evaluator(self):
+    def make_device_evaluator(self, device=None):
         from ...ops import pfsp_device
 
         # Tables are built once per problem instance and shared by all
@@ -182,7 +182,7 @@ class PFSPProblem(Problem):
             self._device_tables = pfsp_device.PFSPDeviceTables(
                 self.lb1_data, self.lb2_data
             )
-        return pfsp_device.make_evaluator(self._device_tables, self.lb)
+        return pfsp_device.make_evaluator(self._device_tables, self.lb, device)
 
     def generate_children(
         self, parents: NodeBatch, count: int, results: np.ndarray, best: int
