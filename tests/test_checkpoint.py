@@ -69,3 +69,45 @@ def test_checkpoint_refuses_wrong_problem(tmp_path):
         ckpt.load(path, NQueensProblem(N=10))
     with pytest.raises(ValueError, match="checkpoint is for"):
         ckpt.load(path, PFSPProblem(inst=14))
+
+
+def test_checkpoint_refuses_different_ptimes(tmp_path):
+    """Two ad-hoc instances with identical (jobs, machines) but different
+    processing times must not resume each other (ADVICE r1: meta needs a
+    p_times digest, not just shapes)."""
+    import numpy as np
+
+    path = str(tmp_path / "adhoc.ckpt")
+    ptm_a = taillard.reduced_instance(14, jobs=6, machines=4)
+    ptm_b = np.ascontiguousarray(ptm_a.copy())
+    ptm_b[0, 0] += 1
+    prob_a = PFSPProblem(lb="lb1", ub=0, p_times=ptm_a)
+    prob_b = PFSPProblem(lb="lb1", ub=0, p_times=ptm_b)
+    batch = prob_a.root()
+    ckpt.save(path, prob_a, batch, best=10**9, tree=0, sol=0)
+    ckpt.load(path, prob_a)  # same instance: fine
+    with pytest.raises(ValueError, match="checkpoint is for"):
+        ckpt.load(path, prob_b)
+
+
+def test_resolve_capacity_grows_for_chunk_floor():
+    """A tiny explicit capacity must grow to fit the 64-chunk floor rather
+    than leave M*n > capacity/2, which would starve the device loop and
+    silently run everything through the host-offload fallback (ADVICE r1)."""
+    from tpu_tree_search.engine.resident import resolve_capacity
+
+    prob = NQueensProblem(N=12)
+    capacity, M = resolve_capacity(prob, M=50000, capacity=256)
+    assert M >= 64
+    assert 2 * M * prob.child_slots <= capacity
+
+
+def test_cli_rejects_mesh_offload_and_stray_perc(capsys):
+    from tpu_tree_search import cli
+
+    with pytest.raises(SystemExit):
+        cli.main(["nqueens", "--tier", "mesh", "--engine", "offload"])
+    assert "resident-only" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        cli.main(["nqueens", "--tier", "seq", "--perc", "0.3"])
+    assert "--perc only applies" in capsys.readouterr().err

@@ -87,6 +87,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def validate_args(parser: argparse.ArgumentParser, args) -> None:
+    """Reject flag combinations that would otherwise be silently ignored."""
+    if args.tier == "mesh" and args.engine == "offload":
+        parser.error(
+            "--engine offload is not available for --tier mesh "
+            "(the mesh tier is resident-only; use --tier multi for "
+            "host-orchestrated offload across devices)"
+        )
+    if args.perc != 0.5 and args.tier not in ("multi", "dist"):
+        parser.error(
+            "--perc only applies to the work-stealing tiers (multi, dist)"
+        )
+
+
 def make_problem(args):
     if args.problem == "nqueens":
         from .problems import NQueensProblem
@@ -148,7 +162,7 @@ def run_tier(problem, args):
         )
     from .parallel.dist import dist_search
 
-    return dist_search(problem, m=args.m, M=args.M, D=args.D)
+    return dist_search(problem, m=args.m, M=args.M, D=args.D, perc=args.perc)
 
 
 def print_settings(args) -> None:
@@ -236,12 +250,24 @@ def enable_compile_cache() -> None:
     want = os.environ.get("TTS_COMPILE_CACHE", "")
     if want == "0":
         return
-    path = want or os.path.join(
-        os.path.expanduser("~"), ".cache", "tpu_tree_search", "xla"
-    )
     try:
-        import jax
+        import platform
+        import socket
 
+        import jax
+        import jaxlib
+
+        # Key the cache by build + host: an AOT executable produced by a
+        # different libtpu/jaxlib build or another machine's CPU features
+        # must never be loaded (observed failure modes: libtpu
+        # FAILED_PRECONDITION version mismatch, XLA:CPU SIGILL warnings).
+        key = "-".join([
+            jax.__version__, jaxlib.__version__,
+            platform.machine(), socket.gethostname(),
+        ])
+        path = want or os.path.join(
+            os.path.expanduser("~"), ".cache", "tpu_tree_search", "xla", key
+        )
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -250,7 +276,9 @@ def enable_compile_cache() -> None:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    validate_args(parser, args)
     enable_compile_cache()
     try:
         problem = make_problem(args)

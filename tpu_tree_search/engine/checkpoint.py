@@ -24,7 +24,7 @@ import numpy as np
 
 from ..problems.base import NodeBatch, Problem
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2: PFSP meta carries a p_times digest (ptimes_sha)
 
 
 class RunController:
@@ -82,8 +82,16 @@ def problem_meta(problem: Problem) -> dict:
     if problem.name == "nqueens":
         meta.update(N=problem.N, g=problem.g)
     elif problem.name == "pfsp":
+        import hashlib
+
+        # Digest of the processing-times matrix: two ad-hoc instances with
+        # the same (jobs, machines) but different p_times must not resume
+        # each other's frontiers (inst=None alone cannot tell them apart).
+        pt = np.ascontiguousarray(problem.lb1_data.p_times, dtype=np.int64)
+        digest = hashlib.sha256(pt.tobytes()).hexdigest()[:16]
         meta.update(inst=getattr(problem, "inst", None), lb=problem.lb,
-                    ub=problem.ub, jobs=problem.jobs, machines=problem.machines)
+                    ub=problem.ub, jobs=problem.jobs, machines=problem.machines,
+                    ptimes_sha=digest)
     return meta
 
 

@@ -439,6 +439,12 @@ def resolve_capacity(problem: Problem, M: int, capacity: int | None) -> tuple[in
         )
         capacity = default_capacity(M, n, node_bytes)
     M = min(M, max(64, (capacity // 2) // n))
+    # If the 64-chunk floor binds, grow the pool instead of leaving
+    # M*n > capacity/2 — that would make the device loop's headroom check
+    # (`size + M*n <= capacity`) unsatisfiable and silently run the whole
+    # search through the host-offload fallback.
+    if 2 * M * n > capacity:
+        capacity = 2 * M * n
     return capacity, M
 
 

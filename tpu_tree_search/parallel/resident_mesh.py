@@ -477,6 +477,8 @@ def mesh_resident_search(
                 best = res.best
                 pool.push_back_bulk(res.children)
             diagnostics.kernel_launches += offloader.diagnostics.kernel_launches
+            diagnostics.host_to_device += offloader.diagnostics.host_to_device
+            diagnostics.device_to_host += offloader.diagnostics.device_to_host
             offloader.diagnostics = Diagnostics()
             state = upload(pool.as_batch())
             pool.clear()
