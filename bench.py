@@ -4,15 +4,22 @@ Headline metric (BASELINE.json): nodes/sec/chip on PFSP ta014 (lb1, ub=1,
 single device) = exploredTree / device-phase seconds, with strict makespan
 parity (1377) and tree/sol parity against the reference C implementation
 (tree 2573652, sol 2648 — recorded goldens, see tests/test_sequential.py).
+Extra records (same JSON line): PFSP ta014 lb2 (tree 144639, sol 0) and
+N-Queens N=15 (sol 2279184) — BASELINE.md configs 2/4 anchors.
 
-The reference publishes no in-repo numbers (`published: {}` in
-BASELINE.json), so ``vs_baseline`` is reported against REFERENCE_NODES_PER_SEC
-below — the first recorded value of this same benchmark on this hardware
-(round 1); later rounds show relative progress.
+``vs_baseline`` is measured against REFERENCE_NODES_PER_SEC below: the first
+*recorded* value of this benchmark on this hardware — 1,414,503 nodes/s,
+verified on the real v5e chip in the round-2 review (`TTS_PALLAS=0
+python bench.py`). The reference repo publishes no in-repo numbers
+(`published: {}` in BASELINE.json), so this self-anchor is the honest floor;
+later rounds show relative progress.
 
-Engine: the device-resident tier (pool in HBM, chunk cycles inside one
-jitted while-loop) — ~10x the classic host-offload loop on remote-TPU
-runtimes because it removes the per-chunk host round trip.
+Robustness (the reference always emits its stats line,
+`pfsp_gpu_cuda.c:140-148` — so must we): the Pallas kernels are probed in a
+SUBPROCESS with a timeout first; if the probe crashes, hangs, or
+mismatches the jnp oracle, the whole bench runs with ``TTS_PALLAS=0`` (the
+jnp/XLA path) and records ``pallas: false`` plus the error. A kernel
+regression can cost performance, never the round's number.
 
 Runs on whatever platform jax picks (real TPU under the driver). Set
 JAX_PLATFORMS=cpu to smoke-test on CPU.
@@ -21,14 +28,92 @@ JAX_PLATFORMS=cpu to smoke-test on CPU.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-# Self-anchored baseline: round-1 recorded nodes/sec of this benchmark on the
-# v5e chip (the reference repo publishes no numbers to compare against).
-REFERENCE_NODES_PER_SEC = 100_000.0
+# Self-anchored baseline: first recorded nodes/sec of the headline benchmark
+# on the v5e chip (round-2 review, jnp path — see module docstring).
+REFERENCE_NODES_PER_SEC = 1_414_503.0
 
-GOLDEN = {"tree": 2_573_652, "sol": 2648, "makespan": 1377}
+GOLDEN_LB1 = {"tree": 2_573_652, "sol": 2648, "makespan": 1377}
+GOLDEN_LB2 = {"tree": 144_639, "sol": 0, "makespan": 1377}
+# Classical N-Queens solution counts (BASELINE.md correctness anchors).
+NQ_SOL = {12: 14_200, 15: 2_279_184}
+
+_PROBE = r"""
+import sys
+import numpy as np, jax
+if jax.default_backend() != "tpu":
+    print("PALLAS_PROBE_SKIP:" + jax.default_backend())
+    sys.exit(0)
+import jax.numpy as jnp
+from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
+from tpu_tree_search.problems import PFSPProblem
+prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+rng = np.random.default_rng(0)
+B = 256
+prmu = np.tile(np.arange(prob.jobs, dtype=np.int32), (B, 1))
+for i in range(B):
+    rng.shuffle(prmu[i])
+limit1 = rng.integers(-1, prob.jobs - 1, size=B).astype(np.int32)
+pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+open_ = np.arange(prob.jobs)[None, :] >= (limit1[:, None] + 1)
+g1 = np.asarray(PK.pfsp_lb1_bounds(pd, ld, t.ptm_t, t.min_heads, t.min_tails))
+r1 = np.asarray(P._lb1_chunk(pd, ld, t.ptm_t, t.min_heads, t.min_tails))
+assert np.array_equal(g1[open_], r1[open_]), "lb1 mismatch"
+g2 = np.asarray(PK.pfsp_lb2_bounds(pd, ld, t))
+r2 = np.asarray(P._lb2_chunk(pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+                             t.pairs, t.lags, t.johnson_schedules))
+assert np.array_equal(g2[open_], r2[open_]), "lb2 mismatch"
+print("PALLAS_PROBE_OK")
+"""
+
+
+def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None]:
+    """Compile + oracle-check the PFSP Pallas kernels in a subprocess.
+
+    A subprocess (not in-process try/except) because a Mosaic compile can
+    *hang*, not just raise — the timeout converts that into a clean
+    fallback instead of eating the driver's whole budget. The backend check
+    also happens in the subprocess: initializing the TPU client in the
+    parent first would lock a single-client runtime out from under the
+    probe.
+    """
+    if os.environ.get("TTS_PALLAS", "1") == "0":
+        return False, "disabled by TTS_PALLAS=0"
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s (compile hang)"
+    for line in res.stdout.splitlines():
+        if line.startswith("PALLAS_PROBE_SKIP:"):
+            backend = line.split(":", 1)[1]
+            return False, f"backend is {backend!r}, not tpu"
+    if res.returncode != 0 or "PALLAS_PROBE_OK" not in res.stdout:
+        tail = (res.stderr or res.stdout).strip().splitlines()[-3:]
+        return False, "probe failed: " + " | ".join(tail)
+    return True, None
+
+
+def run_config(problem, m: int, M: int):
+    """Warm-up run (compiles) + measured run; returns
+    (result, nodes/s, elapsed, device_phase_s)."""
+    from tpu_tree_search.engine.resident import resident_search
+
+    resident_search(problem, m=m, M=M)
+    t0 = time.time()
+    res = resident_search(problem, m=m, M=M)
+    elapsed = time.time() - t0
+    device_phase = res.phases[1].seconds if len(res.phases) > 1 else res.elapsed
+    return res, res.explored_tree / max(device_phase, 1e-9), elapsed, device_phase
 
 
 def main() -> int:
@@ -36,43 +121,96 @@ def main() -> int:
 
     enable_compile_cache()
 
-    from tpu_tree_search.engine.resident import resident_search
-    from tpu_tree_search.problems import PFSPProblem
+    pallas_ok, pallas_err = probe_pallas()
+    if not pallas_ok:
+        os.environ["TTS_PALLAS"] = "0"
 
-    problem = PFSPProblem(inst=14, lb="lb1", ub=1)
+    import jax
 
-    # Throwaway warm-up search compiles the device-resident while-loop
-    # program (~30s first time on TPU); the measured run below reflects
-    # steady-state throughput.
-    resident_search(problem, m=25, M=65536)
+    from tpu_tree_search.problems import NQueensProblem, PFSPProblem
 
-    t0 = time.time()
-    res = resident_search(problem, m=25, M=65536)
-    elapsed = time.time() - t0
+    on_tpu = jax.default_backend() == "tpu"
+    record: dict = {}
+    extras: list[dict] = []
+    try:
+        # -- headline: PFSP ta014 lb1 --------------------------------------
+        res, nps, elapsed, device_phase = run_config(
+            PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=65536
+        )
+        parity = (
+            res.explored_tree == GOLDEN_LB1["tree"]
+            and res.explored_sol == GOLDEN_LB1["sol"]
+            and res.best == GOLDEN_LB1["makespan"]
+        )
+        record = {
+            "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
+            "value": round(nps, 1),
+            "unit": "nodes/sec",
+            "vs_baseline": round(nps / REFERENCE_NODES_PER_SEC, 3),
+            "parity": parity,
+            "explored_tree": res.explored_tree,
+            "explored_sol": res.explored_sol,
+            "makespan": res.best,
+            "device_phase_s": round(device_phase, 3),
+            "total_s": round(elapsed, 3),
+            "kernel_launches": res.diagnostics.kernel_launches,
+        }
+    except Exception as e:  # noqa: BLE001 — the line must still print
+        record = {
+            "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "nodes/sec",
+            "vs_baseline": 0.0,
+            "parity": False,
+            "error": f"{type(e).__name__}: {e}",
+        }
 
-    device_phase = res.phases[1].seconds if len(res.phases) > 1 else res.elapsed
-    nodes_per_sec = res.explored_tree / max(device_phase, 1e-9)
+    # -- extras: ta014 lb2 + N-Queens N=15 (never fail the bench) ----------
+    try:
+        # CPU smoke: small chunks — the jnp lb2's per-pair (B, n, n)
+        # intermediates make huge chunks crawl without the TPU's bandwidth.
+        res2, nps2, _, _ = run_config(
+            PFSPProblem(inst=14, lb="lb2", ub=1), m=25,
+            M=65536 if on_tpu else 4096,
+        )
+        extras.append({
+            "metric": "pfsp_ta014_lb2_nodes_per_sec_per_chip",
+            "value": round(nps2, 1),
+            "parity": (
+                res2.explored_tree == GOLDEN_LB2["tree"]
+                and res2.explored_sol == GOLDEN_LB2["sol"]
+                and res2.best == GOLDEN_LB2["makespan"]
+            ),
+            "explored_tree": res2.explored_tree,
+            "makespan": res2.best,
+        })
+    except Exception as e:  # noqa: BLE001
+        extras.append({
+            "metric": "pfsp_ta014_lb2_nodes_per_sec_per_chip",
+            "error": f"{type(e).__name__}: {e}",
+        })
+    N = 15 if on_tpu else 12  # CPU smoke stays fast
+    try:
+        resq, npsq, _, _ = run_config(NQueensProblem(N=N), m=25, M=65536)
+        extras.append({
+            "metric": f"nqueens_n{N}_nodes_per_sec_per_chip",
+            "value": round(npsq, 1),
+            "parity": resq.explored_sol == NQ_SOL[N],
+            "explored_tree": resq.explored_tree,
+            "explored_sol": resq.explored_sol,
+        })
+    except Exception as e:  # noqa: BLE001
+        extras.append({
+            "metric": f"nqueens_n{N}_nodes_per_sec_per_chip",
+            "error": f"{type(e).__name__}: {e}",
+        })
 
-    parity = (
-        res.explored_tree == GOLDEN["tree"]
-        and res.explored_sol == GOLDEN["sol"]
-        and res.best == GOLDEN["makespan"]
-    )
-    record = {
-        "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
-        "value": round(nodes_per_sec, 1),
-        "unit": "nodes/sec",
-        "vs_baseline": round(nodes_per_sec / REFERENCE_NODES_PER_SEC, 3),
-        "parity": parity,
-        "explored_tree": res.explored_tree,
-        "explored_sol": res.explored_sol,
-        "makespan": res.best,
-        "device_phase_s": round(device_phase, 3),
-        "total_s": round(elapsed, 3),
-        "kernel_launches": res.diagnostics.kernel_launches,
-    }
+    record["pallas"] = pallas_ok
+    if pallas_err:
+        record["pallas_error"] = pallas_err
+    record["extra"] = extras
     print(json.dumps(record))
-    return 0 if parity else 1
+    return 0 if record.get("parity") else 1
 
 
 if __name__ == "__main__":

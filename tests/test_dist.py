@@ -64,3 +64,83 @@ def test_dist_single_host_degenerate():
     ds = dist_search(NQueensProblem(N=8), m=5, M=128, num_hosts=1)
     assert ds.explored_sol == seq.explored_sol
     assert ds.explored_tree == seq.explored_tree
+
+
+def test_allgather_obj_threads():
+    import threading
+
+    coll = ThreadCollectives(3)
+    out = {}
+
+    def run(h):
+        c = coll.bind(h)
+        out[h] = c.allgather_obj({"h": h, "payload": list(range(h))})
+
+    ts = [threading.Thread(target=run, args=(h,)) for h in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert out[0] == out[2]
+    assert [r["h"] for r in out[1]] == [0, 1, 2]
+
+
+def test_skewed_partition_inter_host_steal():
+    """One virtual host starts with ZERO warm nodes; host-mediated stealing
+    must feed it real step-2 work (not just drain leftovers), and the totals
+    must still match the sequential goldens exactly (N-Queens never prunes,
+    so stealing can only change visit order). Reference behavior matched:
+    `pfsp_dist_multigpu_chpl.chpl:520-567`."""
+
+    def all_to_host0(warm, host_id, num_hosts):
+        if host_id == 0:
+            return warm
+        return {k: v[:0] for k, v in warm.items()}
+
+    H, D = 2, 2
+    seq = sequential_search(NQueensProblem(N=10))
+    ds = dist_search(
+        NQueensProblem(N=10), m=5, M=64, D=D, num_hosts=H,
+        steal_interval_s=0.005, partition_fn=all_to_host0,
+    )
+    assert ds.explored_tree == seq.explored_tree
+    assert ds.explored_sol == seq.explored_sol
+    host1_tree = sum(ds.per_worker_tree[D:])
+    assert host1_tree > 0, "starved host explored nothing — no steal happened"
+
+
+def test_dist_steal_disabled_mpi_baseline_semantics():
+    """steal=False keeps the MPI baseline's join-point-only communication
+    (`pfsp_dist_multigpu_cuda.c:570-623`) and stays exact."""
+    seq = sequential_search(NQueensProblem(N=9))
+    ds = dist_search(
+        NQueensProblem(N=9), m=5, M=128, D=2, num_hosts=2, steal=False
+    )
+    assert ds.explored_tree == seq.explored_tree
+    assert ds.explored_sol == seq.explored_sol
+
+
+def test_pfsp_dist_steal_improving_incumbent():
+    """ub=0 with stealing + periodic UB exchange must still find the
+    optimum (B&B relaxation: node counts may differ, optimum may not)."""
+    ptm = T.reduced_instance(21, jobs=8, machines=6)
+    seq = sequential_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm))
+    ds = dist_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm),
+        m=5, M=64, D=2, num_hosts=2, steal_interval_s=0.005,
+    )
+    assert ds.best == seq.best
+
+
+def test_dist_terminates_with_drain_leftovers():
+    """Regression: with m=25 and D=3 the per-pool drain leftovers (< m each)
+    can sum past 2m per host while NO single pool can donate — the
+    quiescence test must key on the largest pool, or termination never
+    fires and the tier hangs."""
+    seq = sequential_search(NQueensProblem(N=9))
+    ds = dist_search(
+        NQueensProblem(N=9), m=25, M=64, D=3, num_hosts=2,
+        steal_interval_s=0.005,
+    )
+    assert ds.explored_tree == seq.explored_tree
+    assert ds.explored_sol == seq.explored_sol

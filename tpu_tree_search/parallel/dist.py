@@ -58,6 +58,9 @@ class LocalCollectives:
     def allreduce_max(self, value) -> float:
         return value
 
+    def allgather_obj(self, value) -> list:
+        return [value]
+
 
 class ThreadCollectives:
     """In-process collectives for H virtual hosts running in threads (the
@@ -96,6 +99,9 @@ class ThreadCollectives:
     def allreduce_max(self, value):
         return max(self._exchange(value))
 
+    def allgather_obj(self, value) -> list:
+        return self._exchange(value)
+
 
 class JaxCollectives:
     """Real multi-host collectives over jax.distributed (DCN). The launcher
@@ -125,6 +131,187 @@ class JaxCollectives:
     def allreduce_max(self, value):
         return type(value)(self._allgather(value).max())
 
+    def allgather_obj(self, value) -> list:
+        """Arbitrary-object allgather over DCN: two rounds (lengths, then a
+        max-length-padded byte buffer). Node blocks are a few hundred KB at
+        most (<= M nodes x ~24 bytes), well within DCN message sizes."""
+        import pickle
+
+        from jax.experimental import multihost_utils
+
+        data = np.frombuffer(pickle.dumps(value), dtype=np.uint8)
+        lens = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([len(data)], dtype=np.int64)
+            )
+        ).reshape(-1)
+        mx = int(lens.max())
+        buf = np.zeros((mx,), dtype=np.uint8)
+        buf[: len(data)] = data
+        gathered = np.asarray(
+            multihost_utils.process_allgather(buf)
+        ).reshape(self.num_hosts, mx)
+        return [
+            pickle.loads(gathered[h, : int(lens[h])].tobytes())
+            for h in range(self.num_hosts)
+        ]
+
+
+class _HostComm:
+    """Per-host communicator: periodic cross-host incumbent exchange,
+    host-mediated work stealing, and two-level termination.
+
+    The Chapel reference steals across locales with remote CAS on the
+    victim's pool lock (`pfsp_dist_multigpu_chpl.chpl:520-567`) — TPU hosts
+    share no memory, so the steal is host-mediated (SURVEY.md §2.5): each
+    host runs this loop in a thread next to its workers, and every
+    ``interval_s`` all hosts meet in a bulk-synchronous exchange round:
+
+      1. allgather ``(pool_size, best, all_workers_idle)``;
+      2. every host adopts the global min incumbent (the periodic UB
+         all-reduce the reference lacks, BASELINE.json north star);
+      3. rich hosts (size >= 2m) are deterministically matched to starving
+         idle hosts (same gathered data on every host -> same matching, no
+         handshake); each donor locks its fullest local pool and pops half
+         its *front* (`Pool_par.chpl:180-191` policy), and a second
+         allgather delivers the blocks;
+      4. a round with all hosts idle, no donations, and only drain-sized
+         leftovers ends the loop everywhere at once (two-level
+         termination, `pfsp_dist_multigpu_chpl.chpl:569-587`); local
+         workers then exit via ``stop_event`` and the per-host drain picks
+         up any sub-chunk remainder, so no work is ever lost.
+    """
+
+    def __init__(self, collectives, m: int, perc: float = 0.5,
+                 interval_s: float = 0.02):
+        self.coll = collectives
+        # Captured here (construction happens on the bound host thread):
+        # ThreadCollectives.host_id is thread-local and the communicator
+        # runs in its own thread, which re-binds with this value.
+        self.me = collectives.host_id
+        self.m = m
+        self.perc = perc
+        self.interval_s = interval_s
+        self.rounds = 0
+        self.blocks_sent = 0
+        self.blocks_received = 0
+        self.nodes_sent = 0
+        self.nodes_received = 0
+        self.error: BaseException | None = None
+        self._inflight = None  # popped-but-undelivered donation block
+
+    def _donate_from(self, pools):
+        """Locked front-steal from the fullest local pool (on behalf of a
+        remote host); None when no pool can spare a block."""
+        victim = max(pools, key=lambda p: p.size)
+        if victim.size < 2 * self.m:
+            return None
+        if not victim.try_lock():
+            return None
+        try:
+            return victim.pop_front_bulk_half(self.m, self.perc)
+        finally:
+            victim.unlock()
+
+    def run(self, pools, states, shared, stop_event):
+        bind = getattr(self.coll, "bind", None)
+        if bind is not None:
+            bind(self.me)
+        try:
+            self._loop(pools, states, shared, stop_event)
+        except BaseException as e:  # never leave workers polling forever
+            self.error = e
+            stop_event.set()
+            # A block popped for donation but not delivered must not be
+            # lost — requeue it locally (counts stay exact; the search
+            # just keeps the work).
+            if self._inflight is not None:
+                pools[0].locked_push_back_bulk(self._inflight)
+                self._inflight = None
+            # ThreadCollectives: wake peers blocked in the barrier. Real
+            # multi-host (JaxCollectives) has no abort — a dead host stalls
+            # the collective, jax's fail-stop model (the reference behaves
+            # identically: a crashed locale hangs allIdle, SURVEY.md §5).
+            barrier = getattr(self.coll, "_barrier", None)
+            if barrier is not None:
+                try:
+                    barrier.abort()
+                except Exception:
+                    pass
+
+    def _loop(self, pools, states, shared, stop_event):
+        import time as _time
+
+        coll = self.coll
+        H = coll.num_hosts
+        me = self.me
+        rrobin = 0
+        from ..problems.base import batch_length
+
+        while True:
+            _time.sleep(self.interval_s)
+            if states.flag.is_set():  # a worker died: abort everywhere
+                stop_event.set()
+                abort = getattr(coll, "_barrier", None)
+                if abort is not None:
+                    abort.abort()
+                return
+            self.rounds += 1
+            size = sum(p.size for p in pools)
+            # Donations come from a single pool, so donor eligibility and
+            # the quiescence test must use the *largest pool*, not the host
+            # sum: D pools can each hold m-1 drain-leftover nodes — a host
+            # sum >= 2m that no pool can ever donate would loop forever.
+            max_pool = max(p.size for p in pools)
+            idle = states._all_idle()
+            best = shared.read()
+            rows = coll.allgather_obj((size, max_pool, best, bool(idle)))
+            gbest = min(r[2] for r in rows)
+            shared.publish(gbest)
+            sizes = [r[0] for r in rows]
+            maxes = [r[1] for r in rows]
+            idles = [r[3] for r in rows]
+            # Deterministic donor->receiver matching (identical on every
+            # host): richest donors paired with hungriest idle receivers.
+            donors = sorted(
+                (h for h in range(H) if maxes[h] >= 2 * self.m),
+                key=lambda h: (-maxes[h], h),
+            )
+            needy = sorted(
+                (h for h in range(H) if idles[h] and sizes[h] < self.m),
+                key=lambda h: (sizes[h], h),
+            )
+            pairs = list(zip(donors, needy))
+            if not pairs:
+                if all(idles) and max(maxes) < 2 * self.m:
+                    # Global quiescence: no pool anywhere can donate and
+                    # every host is idle — stop everywhere in the same
+                    # round (leftovers go to the host drain).
+                    stop_event.set()
+                    return
+                continue
+            payload = None
+            receiver = -1
+            for d, r in pairs:
+                if d == me:
+                    payload = self._donate_from(pools)
+                    receiver = r
+            self._inflight = payload
+            blocks = coll.allgather_obj((receiver, payload))
+            self._inflight = None
+            if payload is not None:
+                self.blocks_sent += 1
+                self.nodes_sent += batch_length(payload)
+            for rcv, batch in blocks:
+                if rcv == me and batch is not None:
+                    # Whole block into one local pool (keeps it >= m so the
+                    # receiving worker can pop; intra-host stealing spreads
+                    # it from there).
+                    pools[rrobin].locked_push_back_bulk(batch)
+                    rrobin = (rrobin + 1) % len(pools)
+                    self.blocks_received += 1
+                    self.nodes_received += batch_length(batch)
+
 
 def _host_search(
     problem: Problem,
@@ -136,17 +323,37 @@ def _host_search(
     initial_best: int | None,
     share_bound: bool,
     seed_base: int = 0xD157,
+    steal: bool = True,
+    steal_interval_s: float = 0.02,
+    perc: float = 0.5,
+    partition_fn=None,
 ):
     """One host's full pipeline (warm-up + stride slice, local multi-device
-    runtime, local drain); returns its local stats for reduction. Delegates
-    to the shared ``host_pipeline`` (SURVEY.md §1: the reference duplicates
-    this scaffolding between its multi and dist mains — we don't)."""
-    return host_pipeline(
+    runtime with an inter-host communicator, local drain); returns its local
+    stats for reduction. Delegates to the shared ``host_pipeline``
+    (SURVEY.md §1: the reference duplicates this scaffolding between its
+    multi and dist mains — we don't)."""
+    comm = None
+    if steal and collectives.num_hosts > 1:
+        comm = _HostComm(
+            collectives, m, perc=perc, interval_s=steal_interval_s
+        )
+    local = host_pipeline(
         problem, m, M, D, devices,
         initial_best=initial_best, share_bound=share_bound,
         num_hosts=collectives.num_hosts, host_id=collectives.host_id,
-        seed=seed_base + collectives.host_id,
+        seed=seed_base + collectives.host_id, perc=perc, comm=comm,
+        partition_fn=partition_fn,
     )
+    if comm is not None:
+        local["comm"] = {
+            "rounds": comm.rounds,
+            "blocks_sent": comm.blocks_sent,
+            "blocks_received": comm.blocks_received,
+            "nodes_sent": comm.nodes_sent,
+            "nodes_received": comm.nodes_received,
+        }
+    return local
 
 
 def _reduce(local: dict, collectives) -> SearchResult:
@@ -176,6 +383,10 @@ def dist_search(
     devices=None,
     initial_best: int | None = None,
     share_bound: bool = True,
+    steal: bool = True,
+    steal_interval_s: float = 0.02,
+    perc: float = 0.5,
+    partition_fn=None,
 ) -> SearchResult:
     """Distributed search entry point.
 
@@ -184,6 +395,11 @@ def dist_search(
     * Single process with ``num_hosts=H > 1``: runs H virtual hosts in
       threads over disjoint local-device groups (testing mode).
     * Single process, ``num_hosts`` unset/1: degenerates to one host.
+
+    ``steal=True`` (default) runs the inter-host communicator: periodic
+    incumbent all-reduce + host-mediated work stealing + two-level
+    termination (see ``_HostComm``); ``steal=False`` keeps the MPI
+    baseline's join-point-only semantics (`pfsp_dist_multigpu_cuda.c`).
     """
     import jax
 
@@ -193,7 +409,9 @@ def dist_search(
         if D is None:
             D = len(local_devices)
         local = _host_search(
-            problem, m, M, D, local_devices, coll, initial_best, share_bound
+            problem, m, M, D, local_devices, coll, initial_best, share_bound,
+            steal=steal, steal_interval_s=steal_interval_s, perc=perc,
+            partition_fn=partition_fn,
         )
         return _reduce(local, coll)
 
@@ -204,7 +422,8 @@ def dist_search(
         if D is None:
             D = len(all_devices)
         local = _host_search(
-            problem, m, M, D, all_devices, coll, initial_best, share_bound
+            problem, m, M, D, all_devices, coll, initial_best, share_bound,
+            steal=False,
         )
         return _reduce(local, coll)
 
@@ -221,15 +440,17 @@ def dist_search(
     results: list = [None] * H
     errors: list = [None] * H
 
+    locals_: list = [None] * H
+
     def host_main(h: int):
         try:
-            results[h] = _reduce(
-                _host_search(
-                    problem, m, M, D, groups[h], coll.bind(h),
-                    initial_best, share_bound,
-                ),
-                coll,
+            locals_[h] = _host_search(
+                problem, m, M, D, groups[h], coll.bind(h),
+                initial_best, share_bound,
+                steal=steal, steal_interval_s=steal_interval_s, perc=perc,
+                partition_fn=partition_fn,
             )
+            results[h] = _reduce(locals_[h], coll)
         except BaseException as e:  # propagate after join
             errors[h] = e
             try:

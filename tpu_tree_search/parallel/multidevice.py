@@ -91,6 +91,7 @@ def _worker_loop(
     shared: _SharedBest | None,
     rng: np.random.Generator,
     perc: float = 0.5,
+    stop_event: threading.Event | None = None,
 ):
     problem = w.problem
     try:
@@ -99,9 +100,14 @@ def _worker_loop(
         D = len(pools)
         chunk_buf = problem.empty_batch(M)
         while True:
+            # Pre-mark BUSY: with an external idle sampler (the dist tier's
+            # communicator thread) marking busy only *after* the pop would
+            # open a window where a worker holds a chunk while looking idle.
+            # For the self-evaluated allIdle scan this is equivalent to the
+            # reference's after-pop transition (`pfsp_multigpu_chpl.chpl:416`).
+            states.set_busy(w.wid)
             count = w.pool.locked_pop_back_bulk(m, M, chunk_buf)
             if count > 0:
-                states.set_busy(w.wid)  # `pfsp_multigpu_chpl.chpl:416-419`
                 if shared is not None:
                     w.best = min(w.best, shared.read())
                 bucket = bucket_size(count, m, M)
@@ -142,6 +148,15 @@ def _worker_loop(
                 continue
             # -- termination (`pfsp_multigpu_chpl.chpl:481-495`) -----------
             states.set_idle(w.wid)
+            if stop_event is not None:
+                # Dist mode: local all-idle is NOT the end — the host may
+                # still receive stolen work from another host. Poll until
+                # the communicator declares global termination (the
+                # two-level scheme, `pfsp_dist_multigpu_chpl.chpl:569-587`).
+                if stop_event.is_set():
+                    return
+                time.sleep(0.0005)
+                continue
             if states.all_idle():
                 return
             time.sleep(0)
@@ -162,6 +177,7 @@ def run_workers(
     share_bound: bool = True,
     seed: int = 0xB0B,
     perc: float = 0.5,
+    comm=None,
 ):
     """Step 2 of the multi-device tier: partition ``pool`` across D worker
     threads, run the offload/steal/terminate loops, join, and merge leftovers
@@ -170,30 +186,48 @@ def run_workers(
     single-host multi tier and the per-host phase of the distributed tier
     (the reference duplicates this scaffolding between its multi and dist
     mains, SURVEY.md §1 note).
+
+    ``comm`` (dist tier): a host communicator with a
+    ``run(pools, states, shared, stop_event)`` method, executed in its own
+    thread alongside the workers. It owns global termination: workers then
+    poll until ``stop_event`` is set instead of exiting on local all-idle.
     """
     pools = _partition(problem, pool, D)
     leftover = SoAPool(problem.node_fields())
     states = TaskStates(D)
-    shared = _SharedBest(best) if share_bound else None
+    shared = _SharedBest(best) if share_bound or comm is not None else None
     workers = [_Worker(w, problem, pools[w], assigned[w]) for w in range(D)]
     for w in workers:
         w.best = best
+    stop_event = threading.Event() if comm is not None else None
     seeds = np.random.SeedSequence(seed)
     threads = [
         threading.Thread(
             target=_worker_loop,
-            args=(w, pools, states, m, M, shared, np.random.default_rng(s), perc),
+            args=(w, pools, states, m, M, shared, np.random.default_rng(s),
+                  perc, stop_event),
             name=f"tts-worker-{w.wid}",
         )
         for w, s in zip(workers, seeds.spawn(D))
     ]
+    comm_thread = None
+    if comm is not None:
+        comm_thread = threading.Thread(
+            target=comm.run, args=(pools, states, shared, stop_event),
+            name="tts-host-comm",
+        )
+        comm_thread.start()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    if comm_thread is not None:
+        comm_thread.join()
     for w in workers:
         if w.error is not None:
             raise w.error
+    if comm is not None and getattr(comm, "error", None) is not None:
+        raise comm.error
     # leftovers back into the global pool (`pfsp_multigpu_chpl.chpl:498-503`)
     for p in pools:
         leftover.push_back_bulk(p.as_batch())
@@ -215,6 +249,8 @@ def host_pipeline(
     host_id: int = 0,
     seed: int = 0xB0B,
     perc: float = 0.5,
+    comm=None,
+    partition_fn=None,
 ) -> dict:
     """The full 3-phase pipeline one host runs: warm-up, partitioned
     parallel offload (work stealing + termination), drain.
@@ -247,14 +283,23 @@ def host_pipeline(
     if num_hosts > 1:
         warm = pool.as_batch()
         pool = SoAPool(problem.node_fields())
-        pool.push_back_bulk({k: v[host_id::num_hosts] for k, v in warm.items()})
+        if partition_fn is None:
+            pool.push_back_bulk(
+                {k: v[host_id::num_hosts] for k, v in warm.items()}
+            )
+        else:
+            # Test/experiment hook: arbitrary (possibly skewed) host
+            # partitions, e.g. to exercise inter-host stealing from a host
+            # that starts empty.
+            pool.push_back_bulk(partition_fn(warm, host_id, num_hosts))
         if host_id != 0:
             tree1 = sol1 = 0
     t1 = time.perf_counter()
 
     # -- step 2: partitioned parallel offload ------------------------------
     pool, tree2, sol2, best, workers = run_workers(
-        problem, pool, D, assigned, m, M, best, share_bound, seed=seed, perc=perc
+        problem, pool, D, assigned, m, M, best, share_bound, seed=seed,
+        perc=perc, comm=comm,
     )
     t2 = time.perf_counter()
 
