@@ -68,6 +68,14 @@ g2 = np.asarray(PK.pfsp_lb2_bounds(pd, ld, t))
 r2 = np.asarray(P._lb2_chunk(pd, ld, t.ptm_t, t.min_heads, t.min_tails,
                              t.pairs, t.lags, t.johnson_schedules))
 assert np.array_equal(g2[open_], r2[open_]), "lb2 mismatch"
+from tpu_tree_search.ops import nqueens_device as NQ
+board = np.tile(np.arange(15, dtype=np.uint8), (B, 1))
+for i in range(B):
+    rng.shuffle(board[i])
+depth = rng.integers(0, 15, size=B).astype(np.int32)
+gq = np.asarray(PK.nqueens_labels(jnp.asarray(board), jnp.asarray(depth), 15))
+rq = np.asarray(NQ.make_core(15)(jnp.asarray(board), jnp.asarray(depth)))
+assert np.array_equal(gq, rq), "nqueens mismatch"
 print("PALLAS_PROBE_OK")
 """
 

@@ -111,3 +111,22 @@ def test_cli_rejects_mesh_offload_and_stray_perc(capsys):
     with pytest.raises(SystemExit):
         cli.main(["nqueens", "--tier", "seq", "--perc", "0.3"])
     assert "--perc only applies" in capsys.readouterr().err
+
+
+def test_large_instance_checkpoint_resume(tmp_path):
+    """Interrupt/resume on a 50-job instance: counters continue and the
+    frontier survives the round trip."""
+    path = str(tmp_path / "ta031.ckpt")
+    prob = PFSPProblem(inst=31, lb="lb1", ub=1)
+    part = resident_search(
+        prob, m=25, M=1024, K=2, max_steps=2, checkpoint_path=path
+    )
+    assert not part.complete
+    saved = ckpt.load(path, PFSPProblem(inst=31, lb="lb1", ub=1))
+    assert saved.tree == part.explored_tree
+    assert saved.batch["prmu"].shape[1] == 50
+    res = resident_search(
+        PFSPProblem(inst=31, lb="lb1", ub=1),
+        m=25, M=1024, K=2, max_steps=2, resume_from=path,
+    )
+    assert res.explored_tree > part.explored_tree

@@ -73,3 +73,24 @@ def test_pfsp_improving_incumbent_finds_optimum(lb):
     seq = sequential_search(PFSPProblem(lb=lb, ub=0, p_times=ptm))
     res = resident_search(PFSPProblem(lb=lb, ub=0, p_times=ptm), m=8, M=128, K=32)
     assert res.best == seq.best
+
+
+def test_large_taillard_instances_run():
+    """Job count is a runtime parameter, not a compile-time cap: ta031
+    (50 jobs) and ta111 (500x20, the reference's largest class) must run
+    through the resident engine untouched. The reference needs a rebuild
+    with larger `config param MAX_JOBS` beyond 20 jobs
+    (`PFSP_node.chpl:7`, SURVEY.md §5 long-context note)."""
+    from tpu_tree_search.problems import PFSPProblem
+
+    res = resident_search(
+        PFSPProblem(inst=31, lb="lb1", ub=1), m=25, M=2048, K=2, max_steps=2
+    )
+    assert res.explored_tree > 0
+    assert not res.complete
+
+    res = resident_search(
+        PFSPProblem(inst=111, lb="lb1_d", ub=1), m=25, M=128, K=2, max_steps=1
+    )
+    assert res.explored_tree > 0
+
