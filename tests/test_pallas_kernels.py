@@ -103,3 +103,28 @@ def test_use_pallas_routes_per_device():
 
     cpus = jax.devices("cpu")
     assert pallas_kernels.use_pallas(cpus[0]) is False
+
+
+@pytest.mark.parametrize(
+    "inst,jobs,machines",
+    [(14, 20, 10), (1, 12, 5)],
+)
+def test_lb1_d_bounds_match_oracle(inst, jobs, machines):
+    rng = np.random.default_rng(11)
+    if jobs == 20:
+        prob = PFSPProblem(inst=inst, lb="lb1_d", ub=1)
+    else:
+        ptm = taillard.reduced_instance(inst, jobs=jobs, machines=machines)
+        prob = PFSPProblem(lb="lb1_d", ub=0, p_times=ptm)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    B = 300
+    prmu = np.stack([rng.permutation(jobs).astype(np.int32) for _ in range(B)])
+    limit1 = rng.integers(-1, jobs - 1, B).astype(np.int32)
+    oracle = pfsp_device._lb1_d_chunk(
+        jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads, t.min_tails
+    )
+    got = pallas_kernels.pfsp_lb1_d_bounds(
+        jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads, t.min_tails,
+        interpret=True,
+    )
+    assert np.array_equal(np.asarray(oracle), np.asarray(got))

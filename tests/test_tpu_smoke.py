@@ -94,3 +94,19 @@ def test_lb2_kernel_compiles_on_tpu(pfsp14):
         )
     )
     np.testing.assert_array_equal(got[open_], ref[open_])
+
+
+def test_lb1_d_kernel_compiles_on_tpu(pfsp14):
+    import jax.numpy as jnp
+
+    from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
+
+    prob, t, prmu, limit1, open_ = pfsp14
+    prmu_d, l1_d = jnp.asarray(prmu), jnp.asarray(limit1)
+    got = np.asarray(
+        PK.pfsp_lb1_d_bounds(prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails)
+    )
+    ref = np.asarray(
+        P._lb1_d_chunk(prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails)
+    )
+    np.testing.assert_array_equal(got[open_], ref[open_])

@@ -68,9 +68,17 @@ def build_parser() -> argparse.ArgumentParser:
                         help="stop after this many device dispatches "
                         "(checkpointing cutoff; result is marked incomplete)")
     common.add_argument("--perc", type=float, default=0.5,
-                        help="multi tier: fraction of a victim's pool front "
-                        "taken per steal (the CUDA baseline's --perc; 0.5 = "
-                        "the steal-half policy)")
+                        help="multi/dist tiers: fraction of a victim's pool "
+                        "front taken per steal (the CUDA baseline's --perc; "
+                        "0.5 = the steal-half policy)")
+    common.add_argument("--hosts", type=int, default=None,
+                        help="dist tier: number of virtual hosts to run in "
+                        "one process (testing mode; real pods use "
+                        "jax.distributed and ignore this)")
+    common.add_argument("--no-steal", action="store_true",
+                        help="dist tier: disable inter-host stealing + "
+                        "incumbent exchange (MPI-baseline join-point-only "
+                        "semantics)")
     common.add_argument("--profile", type=str, default=None,
                         help="write a jax profiler trace of the search to "
                         "this directory (view with TensorBoard/XProf)")
@@ -99,6 +107,10 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
         parser.error(
             "--perc only applies to the work-stealing tiers (multi, dist)"
         )
+    if (args.hosts is not None or args.no_steal) and args.tier != "dist":
+        parser.error("--hosts/--no-steal only apply to --tier dist")
+    if args.hosts is not None and args.hosts < 1:
+        parser.error("--hosts must be >= 1")
 
 
 def make_problem(args):
@@ -162,7 +174,10 @@ def run_tier(problem, args):
         )
     from .parallel.dist import dist_search
 
-    return dist_search(problem, m=args.m, M=args.M, D=args.D, perc=args.perc)
+    return dist_search(
+        problem, m=args.m, M=args.M, D=args.D, perc=args.perc,
+        num_hosts=args.hosts, steal=not args.no_steal,
+    )
 
 
 def print_settings(args) -> None:
@@ -220,6 +235,13 @@ def print_results(args, problem, res) -> None:
         print(
             f"Device diagnostics: kernel_launch={d.kernel_launches} "
             f"host_to_device={d.host_to_device} device_to_host={d.device_to_host}"
+        )
+    if res.comm:
+        c = res.comm
+        print(
+            f"Inter-host comm: exchange_rounds={c['rounds']} "
+            f"stolen_blocks={c['blocks_received']} "
+            f"stolen_nodes={c['nodes_received']}"
         )
     print("=================================================\n")
 

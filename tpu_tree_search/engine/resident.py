@@ -341,7 +341,7 @@ class _PFSPResident(_ResidentProgram):
             if lb == "lb1":
                 bounds = P.lb1_bounds(prmu_c, limit1_c, t, device)
             elif lb == "lb1_d":
-                bounds = P._lb1_d_chunk(prmu_c, limit1_c, t.ptm_t, t.min_heads, t.min_tails)
+                bounds = P.lb1_d_bounds(prmu_c, limit1_c, t, device)
             else:
                 bounds = P.lb2_bounds(prmu_c, limit1_c, t, device)
             pdepth = limit1_c + 1

@@ -44,6 +44,9 @@ class SearchResult:
     # False when the run stopped early (max_steps cutoff) and saved a
     # checkpoint instead of finishing; counters cover work done so far.
     complete: bool = True
+    # dist tier: inter-host communicator totals (exchange rounds, stolen
+    # blocks/nodes), summed across hosts.
+    comm: dict | None = None
 
     def workload_shares(self) -> list[float]:
         """Per-worker share of explored nodes (load-balance report,

@@ -163,11 +163,18 @@ def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int):
     front = jax.lax.fori_loop(0, n, scan_step, jnp.zeros((T, m), jnp.int32))
     front = jnp.where((limit1 == -1)[:, None], heads, front)
 
+    # Remaining work per machine over the open positions (sum_unscheduled,
+    # `c_bound_simple.c:108-124`).
+    unsched = (
+        jax.lax.broadcasted_iota(jnp.int32, (T, n), 1) >= (limit1 + 1)[:, None]
+    ).astype(jnp.int32)
+    remain = jnp.sum(ptg * unsched[:, :, None], axis=1)  # (T, m)
+
     f = front[:, None, :]  # (T, 1, m)
     child_front = [f[..., 0] + ptg[..., 0]]
     for j in range(1, m):
         child_front.append(jnp.maximum(child_front[-1], f[..., j]) + ptg[..., j])
-    return onehot, ptg, front, child_front
+    return onehot, ptg, front, remain, child_front
 
 
 def _lb1_kernel(
@@ -183,16 +190,9 @@ def _lb1_kernel(
     prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
     limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
     ptm = ptm_ref[:].astype(jnp.float32)  # (n, m) job-major
-    T = prmu.shape[0]
-    _, ptg, _, child_front = _tile_parent_state(
+    _, ptg, _, remain, child_front = _tile_parent_state(
         prmu, limit1, ptm, heads_ref[:], scan_ref, n, m
     )
-
-    # remaining work per machine after removing the child job.
-    unsched = (
-        jax.lax.broadcasted_iota(jnp.int32, (T, n), 1) >= (limit1 + 1)[:, None]
-    ).astype(jnp.int32)
-    remain = jnp.sum(ptg * unsched[:, :, None], axis=1)  # (T, m)
 
     # Child k: machine bound chain, unrolled over m.
     tails = tails_ref[:][0]  # (m,)
@@ -207,8 +207,11 @@ def _lb1_kernel(
 
 
 @lru_cache(maxsize=None)
-def _lb1_call(n: int, m: int, B: int, tile: int, interpret: bool):
-    kernel = partial(_lb1_kernel, n=n, m=m)
+def _lb1_family_call(kernel_fn, n: int, m: int, B: int, tile: int, interpret: bool):
+    """Shared pallas_call factory for the lb1-shaped kernels (lb1 / lb1_d):
+    same operand layout — (prmu, limit1, ptm, heads, tails) -> (B, n) —
+    same tiling, same scan scratch."""
+    kernel = partial(kernel_fn, n=n, m=m)
     grid = (B // tile,)
     return pl.pallas_call(
         kernel,
@@ -224,6 +227,62 @@ def _lb1_call(n: int, m: int, B: int, tile: int, interpret: bool):
         out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
         interpret=interpret,
+    )
+
+
+def _lb1_family_bounds(
+    kernel_fn, prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool
+):
+    B, n = prmu.shape
+    m = ptm_t.shape[1]
+    tile = min(256, B)
+    Bp = _round_up(B, tile)
+    if Bp != B:
+        prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
+        limit1 = jnp.pad(limit1, ((0, Bp - B),))
+    out = _lb1_family_call(kernel_fn, n, m, Bp, tile, interpret)(
+        prmu.astype(jnp.int32),
+        limit1.astype(jnp.int32)[:, None],
+        ptm_t.astype(jnp.int32),
+        min_heads.astype(jnp.int32)[None, :],
+        min_tails.astype(jnp.int32)[None, :],
+    )
+    return out[:B]
+
+
+def _lb1_d_kernel(
+    prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, scan_ref,
+    *, n: int, m: int
+):
+    """lb1_d bounds of every child in the tile: the O(m)-per-child weak bound
+    from the parent's front/remain (`add_front_and_bound`,
+    `c_bound_simple.c:213-244`; device: `evaluate.cu:51-71`). Math identical
+    to `ops/pfsp_device._lb1_d_chunk`; shares the VMEM tile prologue."""
+    prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
+    limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
+    ptm = ptm_ref[:].astype(jnp.float32)  # (n, m)
+    T = prmu.shape[0]
+    _, ptg, front, remain, _ = _tile_parent_state(
+        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m
+    )
+    back = tails_ref[:][0]  # (m,)
+    f = front[:, None, :]  # (T, 1, m)
+    r = remain[:, None, :]
+    lb = f[..., 0] + r[..., 0] + back[0]  # (T, 1) broadcasts to (T, n)
+    tmp0 = f[..., 0] + ptg[..., 0]  # (T, n)
+    for i in range(1, m):
+        tmp1 = jnp.maximum(tmp0, f[..., i])
+        lb = jnp.maximum(lb, tmp1 + r[..., i] + back[i])
+        tmp0 = tmp1 + ptg[..., i]
+    out_ref[:] = jnp.broadcast_to(lb, (T, n))
+
+
+def pfsp_lb1_d_bounds(
+    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False
+):
+    """(B, n) int32 lb1_d child bounds; same contract as `_lb1_d_chunk`."""
+    return _lb1_family_bounds(
+        _lb1_d_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret
     )
 
 
@@ -246,7 +305,7 @@ def _lb2_kernel(
     ptm = ptm_ref[:].astype(jnp.float32)  # (n, m)
     T = prmu.shape[0]
     hp = _hp_dot
-    onehot, _, _, cf = _tile_parent_state(
+    onehot, _, _, _, cf = _tile_parent_state(
         prmu, limit1, ptm, heads_ref[:], scan_ref, n, m
     )
     child_front = jnp.stack(cf, axis=-1).astype(jnp.float32)  # (T, n, m)
@@ -362,18 +421,6 @@ def pfsp_lb1_bounds(
     prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False
 ):
     """(B, n) int32 lb1 child bounds; same contract as `_lb1_chunk`."""
-    B, n = prmu.shape
-    m = ptm_t.shape[1]
-    tile = min(256, B)
-    Bp = _round_up(B, tile)
-    if Bp != B:
-        prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
-        limit1 = jnp.pad(limit1, ((0, Bp - B),))
-    out = _lb1_call(n, m, Bp, tile, interpret)(
-        prmu.astype(jnp.int32),
-        limit1.astype(jnp.int32)[:, None],
-        ptm_t.astype(jnp.int32),
-        min_heads.astype(jnp.int32)[None, :],
-        min_tails.astype(jnp.int32)[None, :],
+    return _lb1_family_bounds(
+        _lb1_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret
     )
-    return out[:B]

@@ -294,6 +294,20 @@ def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     return _lb1_chunk(prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails)
 
 
+def lb1_d_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
+    """lb1_d chunk bounds, routed like ``lb1_bounds``
+    (`evaluate.cu:51-71` is the per-parent CUDA counterpart)."""
+    from . import pallas_kernels as PK
+
+    if PK.use_pallas(device) and prmu.shape[-1] <= 64:
+        return PK.pfsp_lb1_d_bounds(
+            prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails
+        )
+    return _lb1_d_chunk(
+        prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails
+    )
+
+
 def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     """lb2 chunk bounds, routed like ``lb1_bounds``. The Pallas kernel keeps
     the whole Johnson pair loop in VMEM — the jnp path's per-pair (B, n, n)
@@ -321,10 +335,7 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):
     elif lb == "lb1_d":
         def evaluate(parents, count, best):
             del count, best
-            return _lb1_d_chunk(
-                parents["prmu"], parents["limit1"], tables.ptm_t,
-                tables.min_heads, tables.min_tails,
-            )
+            return lb1_d_bounds(parents["prmu"], parents["limit1"], tables, device)
     elif lb == "lb2":
         def evaluate(parents, count, best):
             del count, best

@@ -358,11 +358,16 @@ def _host_search(
 
 def _reduce(local: dict, collectives) -> SearchResult:
     """`MPI_Reduce` equivalents: sum tree/sol, min best, max time
-    (`pfsp_dist_multigpu_cuda.c:680-694`)."""
+    (`pfsp_dist_multigpu_cuda.c:680-694`); communicator counters sum too."""
     tree = collectives.allreduce_sum(local["tree"])
     sol = collectives.allreduce_sum(local["sol"])
     best = collectives.allreduce_min(local["best"])
     elapsed = collectives.allreduce_max(local["elapsed"])
+    comm = None
+    if "comm" in local:
+        comm = {
+            k: collectives.allreduce_sum(v) for k, v in local["comm"].items()
+        }
     return SearchResult(
         explored_tree=tree,
         explored_sol=sol,
@@ -371,6 +376,7 @@ def _reduce(local: dict, collectives) -> SearchResult:
         phases=local["phases"],
         diagnostics=local["diag"],
         per_worker_tree=local["per_worker_tree"],
+        comm=comm,
     )
 
 
