@@ -114,3 +114,42 @@ def test_min_heads_tails_follow_c_semantics():
         expect_tails[k] = min(p[k + 1 :, j].sum() for j in range(n))
     assert np.array_equal(d.min_heads, expect_heads)
     assert np.array_equal(d.min_tails, expect_tails)
+
+
+def test_bf16_fast_path_is_bit_exact_and_gated():
+    """The single-pass bf16 MXU gather is exact iff every processing time
+    < 2^8 (one-hot rows and such ints are exactly representable in bf16,
+    accumulation is f32). All Taillard times are 1..99; ad-hoc instances
+    with larger times must disable the fast path."""
+    import jax.numpy as jnp
+
+    from tpu_tree_search.ops import pfsp_device as P
+    from tpu_tree_search.problems import PFSPProblem
+
+    prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+    t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    assert t.exact_bf16 is True
+    rng = np.random.default_rng(5)
+    B = 64
+    prmu = np.stack([rng.permutation(20).astype(np.int32) for _ in range(B)])
+    l1 = rng.integers(-1, 19, B).astype(np.int32)
+    for fn in (P._lb1_chunk, P._lb1_d_chunk):
+        a = np.asarray(fn(jnp.asarray(prmu), jnp.asarray(l1),
+                          t.ptm_t, t.min_heads, t.min_tails, bf16=False))
+        b = np.asarray(fn(jnp.asarray(prmu), jnp.asarray(l1),
+                          t.ptm_t, t.min_heads, t.min_tails, bf16=True))
+        assert np.array_equal(a, b)
+    a = np.asarray(P._lb2_chunk(jnp.asarray(prmu), jnp.asarray(l1),
+                                t.ptm_t, t.min_heads, t.min_tails,
+                                t.pairs, t.lags, t.johnson_schedules, bf16=False))
+    b = np.asarray(P._lb2_chunk(jnp.asarray(prmu), jnp.asarray(l1),
+                                t.ptm_t, t.min_heads, t.min_tails,
+                                t.pairs, t.lags, t.johnson_schedules, bf16=True))
+    assert np.array_equal(a, b)
+
+    big = np.ascontiguousarray(
+        rng.integers(200, 5000, size=(5, 8)).astype(np.int32)
+    )
+    prob_big = PFSPProblem(lb="lb1", ub=0, p_times=big)
+    t_big = P.PFSPDeviceTables(prob_big.lb1_data, prob_big.lb2_data)
+    assert t_big.exact_bf16 is False

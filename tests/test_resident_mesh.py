@@ -76,3 +76,38 @@ def test_single_device_mesh_degenerates():
         seq.explored_tree,
         seq.explored_sol,
     )
+
+
+def test_mesh_resident_lb2_mp_axis_matches_sequential():
+    """(dp, mp) two-axis mesh: the Johnson pair loop splits over mp (pmax
+    combine) while the pool shards over dp. With ub=1 the explored counts
+    must equal the flat-dp mesh AND the sequential tier exactly — the mp
+    replicas stay in lockstep because pmax equalizes every prune decision."""
+    ptm = taillard.reduced_instance(21, jobs=8, machines=6)
+    mk = lambda: PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    r_mp = mesh_resident_search(
+        mk(), m=4, M=64, K=4, rounds=2, D=4, mp=2, initial_best=opt
+    )
+    assert r_mp.best == opt
+    assert (r_mp.explored_tree, r_mp.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    r_flat = mesh_resident_search(
+        mk(), m=4, M=64, K=4, rounds=2, D=8, initial_best=opt
+    )
+    assert (r_flat.explored_tree, r_flat.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+
+
+def test_mesh_resident_mp_rejects_non_lb2():
+    with pytest.raises(ValueError, match="mp-axis"):
+        mesh_resident_search(
+            PFSPProblem(
+                lb="lb1", ub=0,
+                p_times=taillard.reduced_instance(14, jobs=6, machines=4)
+            ),
+            m=4, M=64, D=4, mp=2,
+        )

@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="number of devices/shards (mesh, multi, dist tiers); "
         "default: all local devices",
     )
+    common.add_argument(
+        "--mp", type=int, default=1,
+        help="mesh tier, PFSP lb2 only: shard the Johnson machine-pair "
+        "loop over a second mesh axis of this size (dp x mp devices)",
+    )
     common.add_argument("--stats-file", type=str, default=None,
                         help="append one result line to this .dat file")
     common.add_argument("--json", action="store_true", help="emit one JSON result line")
@@ -111,6 +116,14 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
         parser.error("--hosts/--no-steal only apply to --tier dist")
     if args.hosts is not None and args.hosts < 1:
         parser.error("--hosts must be >= 1")
+    if args.mp != 1:
+        if args.tier != "mesh":
+            parser.error("--mp only applies to --tier mesh")
+        if args.mp < 1:
+            parser.error("--mp must be >= 1")
+        if args.problem != "pfsp" or args.lb != "lb2":
+            parser.error("--mp shards the lb2 Johnson pair loop "
+                         "(pfsp --lb lb2 only)")
 
 
 def make_problem(args):
@@ -164,7 +177,7 @@ def run_tier(problem, args):
         if args.K is not None:
             ckpt_kw["K"] = args.K
         return mesh_resident_search(
-            problem, m=args.m, M=args.M, D=args.D, **ckpt_kw
+            problem, m=args.m, M=args.M, D=args.D, mp=args.mp, **ckpt_kw
         )
     if args.tier == "multi":
         from .parallel.multidevice import multidevice_search

@@ -121,13 +121,18 @@ class _ResidentProgram:
     Subclasses provide the chunk evaluator and the swap position.
     """
 
-    def __init__(self, problem, m: int, M: int, K: int, capacity: int, device):
+    def __init__(self, problem, m: int, M: int, K: int, capacity: int, device,
+                 mp_axis: str | None = None, mp_size: int = 1):
         import jax
 
         self.problem = problem
         self.m = m
         self.M = M
         self.capacity = capacity
+        # Mesh-resident mp sharding of the lb2 pair loop (read by
+        # _make_eval); harmless None/1 everywhere else.
+        self.mp_axis = mp_axis
+        self.mp_size = mp_size
         n = problem.child_slots
         # Counter headroom: every step call accumulates at most K*M*n into
         # int32 counters.
@@ -336,12 +341,20 @@ class _PFSPResident(_ResidentProgram):
         lb = prob.lb
         n = prob.jobs
         device = self.device
+        # Set by the mesh-resident program when the Johnson pair axis is
+        # sharded over a second mesh axis (lb2 only).
+        mp_axis = self.mp_axis
+        mp_size = self.mp_size
 
         def evaluate(prmu_c, limit1_c, valid, best):
             if lb == "lb1":
                 bounds = P.lb1_bounds(prmu_c, limit1_c, t, device)
             elif lb == "lb1_d":
                 bounds = P.lb1_d_bounds(prmu_c, limit1_c, t, device)
+            elif mp_axis is not None:
+                bounds = P.lb2_bounds_mp(
+                    prmu_c, limit1_c, t, mp_axis, mp_size, device
+                )
             else:
                 bounds = P.lb2_bounds(prmu_c, limit1_c, t, device)
             pdepth = limit1_c + 1
@@ -397,20 +410,25 @@ class _NQueensResident(_ResidentProgram):
         return evaluate
 
 
-def _make_program(problem: Problem, m, M, K, capacity, device) -> _ResidentProgram:
+def _make_program(
+    problem: Problem, m, M, K, capacity, device,
+    mp_axis: str | None = None, mp_size: int = 1,
+) -> _ResidentProgram:
     # One compiled program per (problem, config): rebuilding the jit closure
     # would recompile the whole while-loop program on every search (~30 s on
     # TPU), so programs are cached on the problem instance.
     cache = getattr(problem, "_resident_programs", None)
     if cache is None:
         cache = problem._resident_programs = {}
-    key = (m, M, K, capacity, id(device))
+    key = (m, M, K, capacity, id(device), mp_axis, mp_size)
     if key in cache:
         return cache[key]
     if isinstance(problem, PFSPProblem):
-        prog = _PFSPResident(problem, m, M, K, capacity, device)
+        prog = _PFSPResident(problem, m, M, K, capacity, device,
+                             mp_axis=mp_axis, mp_size=mp_size)
     elif isinstance(problem, NQueensProblem):
-        prog = _NQueensResident(problem, m, M, K, capacity, device)
+        prog = _NQueensResident(problem, m, M, K, capacity, device,
+                                mp_axis=mp_axis, mp_size=mp_size)
     else:
         raise TypeError(f"no resident program for {type(problem).__name__}")
     cache[key] = prog

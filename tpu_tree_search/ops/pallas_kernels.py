@@ -115,18 +115,27 @@ def nqueens_labels(board, depth, N: int, g: int = 1, interpret: bool = False):
 # ---------------------------------------------------------------------------
 
 
-def _hp_dot(a, b):
-    """f32 MXU matmul at HIGHEST precision (the default single bf16 pass
-    rounds ints > 256)."""
+def _hp_dot(a, b, bf16: bool = False):
+    """Exact MXU matmul. ``bf16=False``: f32 at HIGHEST precision (the
+    default single bf16 pass rounds ints > 256). ``bf16=True`` (set when
+    every operand value < 2^8 — one-hot/0-1 masks and Taillard times): a
+    single bf16 x bf16 -> f32 pass, bit-exact and ~3x cheaper."""
+    if bf16:
+        a = a.astype(jnp.bfloat16)
+        b = b.astype(jnp.bfloat16)
+        precision = None
+    else:
+        precision = jax.lax.Precision.HIGHEST
     return jax.lax.dot_general(
         a, b,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=precision,
     )
 
 
-def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int):
+def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int,
+                       bf16: bool = False):
     """Shared tile prologue of the PFSP bound kernels: the one-hot MXU gather
     of per-position processing times, the masked schedule_front scan
     (`c_bound_simple.c:51-69`), and the per-child add_forward fronts.
@@ -142,14 +151,18 @@ def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int):
     T = prmu.shape[0]
     jobs_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n, n), 2)
     onehot = (jobs_iota == prmu[:, :, None]).astype(jnp.float32)
-    ptg = _hp_dot(onehot.reshape(T * n, n), ptm).reshape(T, n, m).astype(jnp.int32)
+    ptg = (
+        _hp_dot(onehot.reshape(T * n, n), ptm, bf16)
+        .reshape(T, n, m).astype(jnp.int32)
+    )
 
     # Position-major copy for the scan (same one-hot trick, rows swapped so
     # the reshape lands (n, T, m) without a 3-D transpose).
     iota_nT = jax.lax.broadcasted_iota(jnp.int32, (n, T, n), 2)
     oh_nT = (iota_nT == prmu.T[:, :, None]).astype(jnp.float32)
     scan_ref[...] = (
-        _hp_dot(oh_nT.reshape(n * T, n), ptm).reshape(n, T, m).astype(jnp.int32)
+        _hp_dot(oh_nT.reshape(n * T, n), ptm, bf16)
+        .reshape(n, T, m).astype(jnp.int32)
     )
 
     def scan_step(i, front):
@@ -179,7 +192,7 @@ def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int):
 
 def _lb1_kernel(
     prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, scan_ref,
-    *, n: int, m: int
+    *, n: int, m: int, bf16: bool = False
 ):
     """Full lb1 bound of every child of every parent in the tile.
 
@@ -191,7 +204,7 @@ def _lb1_kernel(
     limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
     ptm = ptm_ref[:].astype(jnp.float32)  # (n, m) job-major
     _, ptg, _, remain, child_front = _tile_parent_state(
-        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m
+        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m, bf16
     )
 
     # Child k: machine bound chain, unrolled over m.
@@ -207,11 +220,12 @@ def _lb1_kernel(
 
 
 @lru_cache(maxsize=None)
-def _lb1_family_call(kernel_fn, n: int, m: int, B: int, tile: int, interpret: bool):
+def _lb1_family_call(kernel_fn, n: int, m: int, B: int, tile: int,
+                     interpret: bool, bf16: bool = False):
     """Shared pallas_call factory for the lb1-shaped kernels (lb1 / lb1_d):
     same operand layout — (prmu, limit1, ptm, heads, tails) -> (B, n) —
     same tiling, same scan scratch."""
-    kernel = partial(kernel_fn, n=n, m=m)
+    kernel = partial(kernel_fn, n=n, m=m, bf16=bf16)
     grid = (B // tile,)
     return pl.pallas_call(
         kernel,
@@ -231,7 +245,8 @@ def _lb1_family_call(kernel_fn, n: int, m: int, B: int, tile: int, interpret: bo
 
 
 def _lb1_family_bounds(
-    kernel_fn, prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool
+    kernel_fn, prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool,
+    bf16: bool = False,
 ):
     B, n = prmu.shape
     m = ptm_t.shape[1]
@@ -240,7 +255,7 @@ def _lb1_family_bounds(
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
         limit1 = jnp.pad(limit1, ((0, Bp - B),))
-    out = _lb1_family_call(kernel_fn, n, m, Bp, tile, interpret)(
+    out = _lb1_family_call(kernel_fn, n, m, Bp, tile, interpret, bf16)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
         ptm_t.astype(jnp.int32),
@@ -252,7 +267,7 @@ def _lb1_family_bounds(
 
 def _lb1_d_kernel(
     prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, scan_ref,
-    *, n: int, m: int
+    *, n: int, m: int, bf16: bool = False
 ):
     """lb1_d bounds of every child in the tile: the O(m)-per-child weak bound
     from the parent's front/remain (`add_front_and_bound`,
@@ -263,7 +278,7 @@ def _lb1_d_kernel(
     ptm = ptm_ref[:].astype(jnp.float32)  # (n, m)
     T = prmu.shape[0]
     _, ptg, front, remain, _ = _tile_parent_state(
-        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m
+        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m, bf16
     )
     back = tails_ref[:][0]  # (m,)
     f = front[:, None, :]  # (T, 1, m)
@@ -278,18 +293,20 @@ def _lb1_d_kernel(
 
 
 def pfsp_lb1_d_bounds(
-    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False
+    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False,
+    bf16: bool = False,
 ):
     """(B, n) int32 lb1_d child bounds; same contract as `_lb1_d_chunk`."""
     return _lb1_family_bounds(
-        _lb1_d_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret
+        _lb1_d_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
+        bf16,
     )
 
 
 def _lb2_kernel(
     prmu_ref, limit1_ref, ptm_ref, heads_ref,
     p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
-    out_ref, scan_ref, *, n: int, m: int, P: int,
+    out_ref, scan_ref, *, n: int, m: int, P: int, bf16: bool = False,
 ):
     """Full lb2 (two-machine Johnson) bound of every child in the tile.
 
@@ -306,7 +323,7 @@ def _lb2_kernel(
     T = prmu.shape[0]
     hp = _hp_dot
     onehot, _, _, _, cf = _tile_parent_state(
-        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m
+        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m, bf16
     )
     child_front = jnp.stack(cf, axis=-1).astype(jnp.float32)  # (T, n, m)
 
@@ -327,7 +344,7 @@ def _lb2_kernel(
     def pair_body(q, lb):
         jord = jorder_ref[q]  # (n, n) slot-order one-hot
         # u_o[b, k, t] = u_child[b, k, sched_q[t]]
-        u_o = hp(u_child.reshape(T * n, n), jord.T).reshape(T, n, n)
+        u_o = hp(u_child.reshape(T * n, n), jord.T, bf16).reshape(T, n, n)
         p0 = p0_ref[q].astype(jnp.float32)  # (n,)
         p1 = p1_ref[q].astype(jnp.float32)
         lag = lag_ref[q].astype(jnp.float32)
@@ -341,8 +358,8 @@ def _lb2_kernel(
         s1 = msel1_ref[q].astype(jnp.float32)
         tmp0_0 = jnp.sum(child_front * s0[None, None, :], axis=-1)  # (T, n)
         tmp1_0 = jnp.sum(child_front * s1[None, None, :], axis=-1)
-        cum0 = hp(mp0.reshape(T * n, n), tri_incl).reshape(T, n, n)
-        suf1 = hp(mp1.reshape(T * n, n), tri_suf).reshape(T, n, n)
+        cum0 = hp(mp0.reshape(T * n, n), tri_incl, bf16).reshape(T, n, n)
+        suf1 = hp(mp1.reshape(T * n, n), tri_suf, bf16).reshape(T, n, n)
         t0 = tmp0_0[:, :, None] + cum0
         a = jnp.where(u_o > 0, t0 + lag[None, None, :] + suf1, neg)
         tmp1 = jnp.maximum(tmp1_0 + jnp.sum(mp1, axis=-1), jnp.max(a, axis=-1))
@@ -358,8 +375,9 @@ def _lb2_kernel(
 
 
 @lru_cache(maxsize=None)
-def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool):
-    kernel = partial(_lb2_kernel, n=n, m=m, P=P)
+def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool,
+              bf16: bool = False):
+    kernel = partial(_lb2_kernel, n=n, m=m, P=P, bf16=bf16)
     grid = (B // tile,)
     full = lambda i: (0, 0)
     return pl.pallas_call(
@@ -389,8 +407,11 @@ def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool):
     )
 
 
-def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False):
+def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
+                    bf16: bool | None = None):
     """(B, n) int32 lb2 child bounds; same contract as `_lb2_chunk`."""
+    if bf16 is None:
+        bf16 = getattr(tables, "exact_bf16", False)
     B, n = prmu.shape
     m = tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
@@ -400,7 +421,7 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False):
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
         limit1 = jnp.pad(limit1, ((0, Bp - B),))
     ordered = tables.johnson_ordered()
-    out = _lb2_call(n, m, P, Bp, tile, interpret)(
+    out = _lb2_call(n, m, P, Bp, tile, interpret, bf16)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
         tables.ptm_t,
@@ -418,9 +439,11 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False):
 
 
 def pfsp_lb1_bounds(
-    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False
+    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False,
+    bf16: bool = False,
 ):
     """(B, n) int32 lb1 child bounds; same contract as `_lb1_chunk`."""
     return _lb1_family_bounds(
-        _lb1_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret
+        _lb1_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
+        bf16,
     )

@@ -68,17 +68,28 @@ def _machine_bound_from_parts(front, back, remain):
     return lb
 
 
-def gather_ptimes(prmu, ptm_t):
+def gather_ptimes(prmu, ptm_t, exact_bf16: bool = False):
     """Per-position processing times ``ptg[b, i, :] = ptm_t[prmu[b, i]]``.
 
-    For small job counts this is a one-hot f32 matmul instead of a gather:
-    the MXU evaluates it far faster than TPU dynamic gathers, and it is exact
-    (one-hot rows select a single int value, and ints < 2^24 are exact in
-    f32). Larger instances fall back to the gather (the (B, n, n) one-hot
-    would dominate memory: at n=50 and a 64k chunk it is already ~650 MB).
+    For small job counts this is a one-hot matmul instead of a gather: the
+    MXU evaluates it far faster than TPU dynamic gathers, and it is exact
+    (one-hot rows select a single int value). ``exact_bf16=True`` (set when
+    every processing time < 256, i.e. all Taillard instances — times are
+    1..99, `c_taillard.c:84`) runs it as a single-pass bf16 x bf16 -> f32
+    matmul: 0/1 one-hot rows and ints < 2^8 are exactly representable in
+    bf16 and the accumulation is f32, so the result is bit-identical to the
+    f32 HIGHEST path at a third or less of the MXU cost. Larger instances
+    fall back to the gather (the (B, n, n) one-hot would dominate memory:
+    at n=50 and a 64k chunk it is already ~650 MB).
     """
     n = prmu.shape[-1]
     if n <= 32:
+        if exact_bf16:
+            oh = jax.nn.one_hot(prmu, n, dtype=jnp.bfloat16)
+            return jnp.einsum(
+                "bkj,jm->bkm", oh, ptm_t.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
         oh = jax.nn.one_hot(prmu, n, dtype=jnp.float32)  # (B, n, n)
         return jnp.einsum(
             "bkj,jm->bkm", oh, ptm_t.astype(jnp.float32),
@@ -88,7 +99,7 @@ def gather_ptimes(prmu, ptm_t):
     return ptm_t[prmu]
 
 
-def _parent_state(prmu, limit1, ptm_t, min_heads):
+def _parent_state(prmu, limit1, ptm_t, min_heads, bf16: bool = False):
     """Shared per-parent precomputation for a chunk.
 
     prmu: (B, n) int32; limit1: (B,) int32; ptm_t: (n, m) int32 (transposed
@@ -101,7 +112,7 @@ def _parent_state(prmu, limit1, ptm_t, min_heads):
       unsched: (B, n) 1.0 where position is free (pos >= limit1 + 1)
     """
     B, n = prmu.shape
-    ptg = gather_ptimes(prmu, ptm_t)  # (B, n, m)
+    ptg = gather_ptimes(prmu, ptm_t, bf16)  # (B, n, m)
     pos = jnp.arange(n, dtype=jnp.int32)[None, :]
     unsched = (pos >= limit1[:, None] + 1).astype(jnp.int32)  # (B, n)
 
@@ -121,8 +132,8 @@ def _parent_state(prmu, limit1, ptm_t, min_heads):
     return front, remain, ptg, unsched
 
 
-@partial(jax.jit, static_argnames=())
-def _lb1_chunk(prmu, limit1, ptm_t, min_heads, min_tails):
+@partial(jax.jit, static_argnames=("bf16",))
+def _lb1_chunk(prmu, limit1, ptm_t, min_heads, min_tails, bf16: bool = False):
     """Bounds of every child of every parent under lb1.
 
     Child slot (i, k), k >= limit1+1: full `lb1_bound` of the child whose
@@ -131,21 +142,21 @@ def _lb1_chunk(prmu, limit1, ptm_t, min_heads, min_tails):
     int32; slots k <= limit1 hold garbage (never read by the host, matching
     the reference's untouched-slot convention, SURVEY.md Appendix A).
     """
-    front, remain, ptg, _ = _parent_state(prmu, limit1, ptm_t, min_heads)
+    front, remain, ptg, _ = _parent_state(prmu, limit1, ptm_t, min_heads, bf16)
     # Child k appends job prmu[:, k]: one add_forward step per slot.
     child_front = _add_forward_batched(front[:, None, :], ptg)  # (B, n, m)
     child_remain = remain[:, None, :] - ptg  # (B, n, m)
     return _machine_bound_from_parts(child_front, min_tails[None, None, :], child_remain)
 
 
-@partial(jax.jit, static_argnames=())
-def _lb1_d_chunk(prmu, limit1, ptm_t, min_heads, min_tails):
+@partial(jax.jit, static_argnames=("bf16",))
+def _lb1_d_chunk(prmu, limit1, ptm_t, min_heads, min_tails, bf16: bool = False):
     """Bounds of every child under lb1_d (`add_front_and_bound`,
     `c_bound_simple.c:213-244`; device: `pfsp_gpu_chpl.chpl:216-235` /
     `evaluate.cu:51-71`): O(m) per child from the parent's front/remain,
     weaker than lb1's full chain but one pass for all children.
     """
-    front, remain, ptg, _ = _parent_state(prmu, limit1, ptm_t, min_heads)
+    front, remain, ptg, _ = _parent_state(prmu, limit1, ptm_t, min_heads, bf16)
     m = front.shape[-1]
     back = min_tails
     f = front[:, None, :]  # (B, 1, m)
@@ -159,7 +170,7 @@ def _lb1_d_chunk(prmu, limit1, ptm_t, min_heads, min_tails):
     return lb
 
 
-@partial(jax.jit, static_argnames=())
+@partial(jax.jit, static_argnames=("bf16",))
 def _lb2_chunk(
     prmu,
     limit1,
@@ -169,6 +180,7 @@ def _lb2_chunk(
     pairs,
     lags,
     johnson_schedules,
+    bf16: bool = False,
 ):
     """Bounds of every child under lb2 (`c_bound_johnson.c:239-254`; device:
     `pfsp_gpu_chpl.chpl:238-254` / `evaluate.cu:73-91`).
@@ -180,7 +192,9 @@ def _lb2_chunk(
     Shapes: pairs (P, 2), lags/johnson_schedules (P, n).
     """
     B, n = prmu.shape
-    front, remain_unused, ptg, unsched = _parent_state(prmu, limit1, ptm_t, min_heads)
+    front, remain_unused, ptg, unsched = _parent_state(
+        prmu, limit1, ptm_t, min_heads, bf16
+    )
     del remain_unused
     child_front = _add_forward_batched(front[:, None, :], ptg)  # (B, n, m)
 
@@ -239,11 +253,39 @@ class PFSPDeviceTables:
 
     def __init__(self, lb1_data, lb2_data):
         self.ptm_t = jnp.asarray(np.ascontiguousarray(lb1_data.p_times.T), dtype=jnp.int32)
+        # Single-pass bf16 MXU gathers are exact iff every time < 2^8
+        # (true for all Taillard instances: times are 1..99).
+        self.exact_bf16 = bool(int(np.max(lb1_data.p_times)) < 256)
         self.min_heads = jnp.asarray(lb1_data.min_heads, dtype=jnp.int32)
         self.min_tails = jnp.asarray(lb1_data.min_tails, dtype=jnp.int32)
         self.pairs = jnp.asarray(lb2_data.pairs, dtype=jnp.int32)
         self.lags = jnp.asarray(lb2_data.lags, dtype=jnp.int32)
         self.johnson_schedules = jnp.asarray(lb2_data.johnson_schedules, dtype=jnp.int32)
+
+    def mp_padded(self, mp_size: int):
+        """(pairs, lags, johnson_schedules) padded to a multiple of
+        ``mp_size`` with copies of pair 0 (max over pairs is idempotent, so
+        duplicates only re-max the same value). Cached per mp_size."""
+        cache = getattr(self, "_mp_padded", None)
+        if cache is None:
+            cache = self._mp_padded = {}
+        if mp_size not in cache:
+            pairs = np.asarray(self.pairs)
+            lags = np.asarray(self.lags)
+            scheds = np.asarray(self.johnson_schedules)
+            P = pairs.shape[0]
+            Pp = -(-P // mp_size) * mp_size
+            if Pp != P:
+                reps = Pp - P
+                pairs = np.concatenate([pairs, np.repeat(pairs[:1], reps, 0)])
+                lags = np.concatenate([lags, np.repeat(lags[:1], reps, 0)])
+                scheds = np.concatenate(
+                    [scheds, np.repeat(scheds[:1], reps, 0)]
+                )
+            cache[mp_size] = (
+                jnp.asarray(pairs), jnp.asarray(lags), jnp.asarray(scheds)
+            )
+        return cache[mp_size]
 
     def johnson_ordered(self):
         if not hasattr(self, "_johnson_ordered"):
@@ -289,9 +331,11 @@ def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     # within VMEM only for small job counts; large instances use the oracle.
     if PK.use_pallas(device) and prmu.shape[-1] <= 64:
         return PK.pfsp_lb1_bounds(
-            prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails
+            prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
+            bf16=tables.exact_bf16,
         )
-    return _lb1_chunk(prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails)
+    return _lb1_chunk(prmu, limit1, tables.ptm_t, tables.min_heads,
+                      tables.min_tails, bf16=tables.exact_bf16)
 
 
 def lb1_d_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
@@ -301,10 +345,12 @@ def lb1_d_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
 
     if PK.use_pallas(device) and prmu.shape[-1] <= 64:
         return PK.pfsp_lb1_d_bounds(
-            prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails
+            prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
+            bf16=tables.exact_bf16,
         )
     return _lb1_d_chunk(
-        prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails
+        prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
+        bf16=tables.exact_bf16,
     )
 
 
@@ -319,7 +365,37 @@ def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     return _lb2_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
         tables.pairs, tables.lags, tables.johnson_schedules,
+        bf16=tables.exact_bf16,
     )
+
+
+def lb2_bounds_mp(prmu, limit1, tables: "PFSPDeviceTables", mp_axis: str,
+                  mp_size: int, device=None):
+    """lb2 chunk bounds with the Johnson machine-pair loop SHARDED over a
+    mesh axis: each ``mp`` shard reduces its pair subset and the shards
+    combine with ``lax.pmax`` (max over machine pairs is the bound; max is
+    associative and idempotent, so padding with copies of pair 0 is safe).
+    Must be called inside shard_map with ``mp_axis`` in scope. The SIMT
+    design has no equivalent of this axis — it is the model-parallel
+    analogue for bound evaluation (SURVEY.md §2.4 note).
+
+    jnp path only: the Pallas kernel's per-pair ordered tables are built
+    host-side for the full pair set; slicing them per shard inside the
+    kernel would need a second staging pass (future work).
+    """
+    del device
+    pairs, lags, scheds = tables.mp_padded(mp_size)
+    P_local = pairs.shape[0] // mp_size
+    idx = jax.lax.axis_index(mp_axis)
+    start = idx * P_local
+    prs = jax.lax.dynamic_slice_in_dim(pairs, start, P_local, axis=0)
+    lgs = jax.lax.dynamic_slice_in_dim(lags, start, P_local, axis=0)
+    sch = jax.lax.dynamic_slice_in_dim(scheds, start, P_local, axis=0)
+    local = _lb2_chunk(
+        prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
+        prs, lgs, sch, bf16=tables.exact_bf16,
+    )
+    return jax.lax.pmax(local, mp_axis)
 
 
 def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):

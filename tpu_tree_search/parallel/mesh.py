@@ -109,19 +109,10 @@ class MeshEvaluator:
         tables = pfsp_device.PFSPDeviceTables(problem.lb1_data, problem.lb2_data)
         jobs = problem.jobs
         lb = problem.lb
-        # Pad the pair tables to a multiple of mp with copies of pair 0 —
-        # a duplicated pair only re-maxes the same value (max is idempotent).
-        pairs = np.asarray(tables.pairs)
-        lags = np.asarray(tables.lags)
-        scheds = np.asarray(tables.johnson_schedules)
         if lb == "lb2":
-            P_pairs = pairs.shape[0]
-            P_padded = _pad_len(P_pairs, self.mp)
-            if P_padded != P_pairs:
-                reps = P_padded - P_pairs
-                pairs = np.concatenate([pairs, np.repeat(pairs[:1], reps, 0)])
-                lags = np.concatenate([lags, np.repeat(lags[:1], reps, 0)])
-                scheds = np.concatenate([scheds, np.repeat(scheds[:1], reps, 0)])
+            # Pair tables padded to a multiple of mp with copies of pair 0
+            # (max over pairs is idempotent) — shared helper.
+            pairs, lags, scheds = tables.mp_padded(self.mp)
 
         node_spec = {"depth": P("dp"), "limit1": P("dp"), "prmu": P("dp", None)}
 
@@ -143,6 +134,7 @@ class MeshEvaluator:
                 local = pfsp_device._lb2_chunk(
                     parents["prmu"], parents["limit1"], ptm_t,
                     min_heads, min_tails, prs, lgs, sch,
+                    bf16=tables.exact_bf16,
                 )
                 bounds = jax.lax.pmax(local, "mp")  # combine pair subsets
                 new_best = _fold_leaf_best(parents, bounds, best, jobs, count)
@@ -163,7 +155,8 @@ class MeshEvaluator:
                      out_specs=(P("dp", None), P()))
             def step(parents, best, ptm_t, min_heads, min_tails, count):
                 bounds = chunk(
-                    parents["prmu"], parents["limit1"], ptm_t, min_heads, min_tails
+                    parents["prmu"], parents["limit1"], ptm_t, min_heads,
+                    min_tails, bf16=tables.exact_bf16,
                 )
                 new_best = _fold_leaf_best(parents, bounds, best, jobs, count)
                 return bounds, new_best

@@ -63,11 +63,23 @@ class _MeshResidentProgram:
     ):
         import jax
 
-        if len(mesh.axis_names) != 1:
-            raise ValueError("mesh-resident tier needs a single-axis mesh")
+        axes = list(mesh.axis_names)
+        if len(axes) == 2:
+            if axes[1] != "mp":
+                raise ValueError(
+                    "mesh-resident tier: two-axis meshes must be (dp, mp)"
+                )
+            if getattr(problem, "lb", None) != "lb2":
+                raise ValueError(
+                    "mp-axis sharding splits the lb2 Johnson pair loop; "
+                    "use a single-axis mesh for other problems/bounds"
+                )
+        elif len(axes) != 1:
+            raise ValueError("mesh-resident tier needs a (dp[, mp]) mesh")
         self.problem = problem
         self.mesh = mesh
-        self.D = int(mesh.shape[mesh.axis_names[0]])
+        self.D = int(mesh.shape[axes[0]])
+        self.mp = int(mesh.shape["mp"]) if len(axes) == 2 else 1
         self.m = m
         self.M = M
         n = problem.child_slots
@@ -79,8 +91,13 @@ class _MeshResidentProgram:
         # K-cycle loop body; its own jitted step is unused here. Built for
         # the mesh's device platform so the kernel routing (Pallas on TPU,
         # XLA elsewhere) matches where the shards actually run.
+        # mp > 1: every (dp, i) shard redundantly owns the same dp pool
+        # block and splits the Johnson pair loop over mp; the pmax inside
+        # the evaluator keeps all mp replicas' prune decisions identical,
+        # so they stay in lockstep without any extra collective.
         self.inner = _make_program(
-            problem, m, M, K, capacity, mesh.devices.flat[0]
+            problem, m, M, K, capacity, mesh.devices.flat[0],
+            mp_axis="mp" if self.mp > 1 else None, mp_size=self.mp,
         )
         self._build()
 
@@ -330,6 +347,7 @@ def mesh_resident_search(
     mesh=None,
     devices=None,
     D: int | None = None,
+    mp: int = 1,
     initial_best: int | None = None,
     warmup_target: int | None = None,
     max_steps: int | None = None,
@@ -344,14 +362,28 @@ def mesh_resident_search(
     import jax
     from jax.sharding import Mesh
 
+    if mesh is not None and mp != 1:
+        raise ValueError(
+            "pass either mesh= (with its own (dp, mp) axes) or mp=, not "
+            "both — mp would be silently ignored"
+        )
     if mesh is None:
         if devices is None:
             devices = jax.devices()
         if D is None:
-            D = len(devices)
-        mesh = Mesh(np.asarray(devices[:D]), ("dp",))
-    if len(mesh.axis_names) != 1:
-        raise ValueError("mesh-resident tier needs a single-axis mesh")
+            D = max(1, len(devices) // mp)
+        if mp > 1:
+            need = D * mp
+            if len(devices) < need:
+                raise ValueError(
+                    f"dp={D} x mp={mp} needs {need} devices, have "
+                    f"{len(devices)}"
+                )
+            mesh = Mesh(
+                np.asarray(devices[:need]).reshape(D, mp), ("dp", "mp")
+            )
+        else:
+            mesh = Mesh(np.asarray(devices[:D]), ("dp",))
     D = int(mesh.shape[mesh.axis_names[0]])
     n = problem.child_slots
     from ..engine.resident import resolve_capacity
@@ -397,7 +429,10 @@ def mesh_resident_search(
     cache = getattr(problem, "_mesh_programs", None)
     if cache is None:
         cache = problem._mesh_programs = {}
-    key = (tuple(id(d) for d in mesh.devices.flat), m, M, K, rounds, T, capacity)
+    key = (
+        tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
+        m, M, K, rounds, T, capacity,
+    )
     program = cache.get(key)
     if program is None:
         program = cache[key] = _MeshResidentProgram(
