@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Benchmark sweep over the BASELINE.md config table.
+
+Runs each named config through the library tiers and appends one JSON line
+per run to a stats file — the experiment-harvesting workflow the reference
+drives with its `stats_pfsp_*_cuda.dat` appends (`pfsp_gpu_cuda.c:140-148`),
+generalized to every tier.
+
+    python scripts/sweep.py                     # default set, ./sweep_stats.jsonl
+    python scripts/sweep.py --quick             # small instances only (CPU-friendly)
+    python scripts/sweep.py --configs nq15,ta014_lb1 --stats-file out.jsonl
+
+Configs (BASELINE.md "Targets" table):
+    nq14_seq     N-Queens N=14, sequential           (parity anchor)
+    nq14         N-Queens N=14, device-resident
+    nq15         N-Queens N=15, device-resident
+    nq17         N-Queens N=17, device-resident      (large; TPU recommended)
+    ta014_lb1    PFSP ta014 lb1  ub=1, device-resident
+    ta014_lb1d   PFSP ta014 lb1_d ub=1, device-resident
+    ta014_lb2    PFSP ta014 lb2  ub=1, device-resident
+    ta021_lb2    PFSP ta021 lb2  ub=1, device-resident (large; TPU recommended)
+    ta014_mesh   PFSP ta014 lb2  ub=1, mesh tier (all local devices)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _nq(N):
+    from tpu_tree_search.problems import NQueensProblem
+
+    return NQueensProblem(N=N)
+
+
+def _pfsp(inst, lb):
+    from tpu_tree_search.problems import PFSPProblem
+
+    return PFSPProblem(inst=inst, lb=lb, ub=1)
+
+
+def run_config(name: str, M: int):
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.engine.sequential import sequential_search
+    from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
+
+    if name == "nq14_seq":
+        return sequential_search(_nq(14)), {"tier": "seq"}
+    if name.startswith("nq"):
+        N = int(name[2:4])
+        return resident_search(_nq(N), m=25, M=M), {"tier": "device"}
+    if name == "ta014_mesh":
+        return mesh_resident_search(_pfsp(14, "lb2"), m=25, M=min(M, 16384)), {
+            "tier": "mesh"
+        }
+    inst = int(name[2:5])
+    lb = {"lb1": "lb1", "lb1d": "lb1_d", "lb2": "lb2"}[name.split("_")[1]]
+    return resident_search(_pfsp(inst, lb), m=25, M=M), {"tier": "device"}
+
+
+DEFAULT = [
+    "nq14_seq", "nq14", "nq15", "ta014_lb1", "ta014_lb1d", "ta014_lb2",
+    "ta014_mesh",
+]
+QUICK = ["nq14_seq", "nq14", "ta014_lb1", "ta014_lb1d"]
+LARGE = ["nq17", "ta021_lb2"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--configs", type=str, default=None,
+                    help="comma-separated subset (default: standard set; "
+                    "'all' adds the large TPU-scale configs)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small CPU-friendly subset")
+    ap.add_argument("--stats-file", type=str, default="sweep_stats.jsonl")
+    ap.add_argument("--M", type=int, default=65536)
+    args = ap.parse_args()
+
+    from tpu_tree_search.cli import enable_compile_cache
+
+    enable_compile_cache()
+
+    if args.configs == "all":
+        names = DEFAULT + LARGE
+    elif args.configs:
+        names = [c.strip() for c in args.configs.split(",")]
+    elif args.quick:
+        names = QUICK
+    else:
+        names = DEFAULT
+
+    failures = 0
+    for name in names:
+        t0 = time.time()
+        try:
+            res, extra = run_config(name, args.M)
+            phase = (
+                res.phases[1].seconds
+                if len(res.phases) > 1
+                else res.elapsed
+            )
+            rec = {
+                "config": name,
+                "explored_tree": res.explored_tree,
+                "explored_sol": res.explored_sol,
+                "best": res.best,
+                "elapsed_s": round(res.elapsed, 3),
+                "device_phase_s": round(phase, 3),
+                "nodes_per_sec": round(res.explored_tree / max(phase, 1e-9), 1),
+                **extra,
+            }
+        except Exception as e:  # noqa: BLE001 — sweep must finish
+            failures += 1
+            rec = {"config": name, "error": f"{type(e).__name__}: {e}",
+                   "elapsed_s": round(time.time() - t0, 3)}
+        print(json.dumps(rec), flush=True)
+        with open(args.stats_file, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,11 +31,12 @@ def test_nqueens_labels_match_oracle(g):
     assert np.array_equal(np.asarray(oracle), np.asarray(got))
 
 
+@pytest.mark.parametrize("bf16", [False, True])
 @pytest.mark.parametrize(
     "inst,jobs,machines",
     [(14, 20, 10), (1, 12, 5)],
 )
-def test_lb1_bounds_match_oracle(inst, jobs, machines):
+def test_lb1_bounds_match_oracle(inst, jobs, machines, bf16):
     rng = np.random.default_rng(3)
     if jobs == 20:
         prob = PFSPProblem(inst=inst, lb="lb1", ub=1)
@@ -51,16 +52,17 @@ def test_lb1_bounds_match_oracle(inst, jobs, machines):
     )
     got = pallas_kernels.pfsp_lb1_bounds(
         jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads, t.min_tails,
-        interpret=True,
+        interpret=True, bf16=bf16,
     )
     assert np.array_equal(np.asarray(oracle), np.asarray(got))
 
 
+@pytest.mark.parametrize("bf16", [False, True])
 @pytest.mark.parametrize(
     "inst,jobs,machines",
     [(14, 20, 10), (1, 12, 5)],
 )
-def test_lb2_bounds_match_oracle(inst, jobs, machines):
+def test_lb2_bounds_match_oracle(inst, jobs, machines, bf16):
     rng = np.random.default_rng(11)
     if jobs == 20:
         prob = PFSPProblem(inst=inst, lb="lb2", ub=1)
@@ -76,7 +78,7 @@ def test_lb2_bounds_match_oracle(inst, jobs, machines):
         t.min_tails, t.pairs, t.lags, t.johnson_schedules,
     )
     got = pallas_kernels.pfsp_lb2_bounds(
-        jnp.asarray(prmu), jnp.asarray(limit1), t, interpret=True
+        jnp.asarray(prmu), jnp.asarray(limit1), t, interpret=True, bf16=bf16
     )
     # Compare only open child slots (k > limit1): closed slots are garbage
     # by contract (never read by the host/engine).
@@ -105,11 +107,12 @@ def test_use_pallas_routes_per_device():
     assert pallas_kernels.use_pallas(cpus[0]) is False
 
 
+@pytest.mark.parametrize("bf16", [False, True])
 @pytest.mark.parametrize(
     "inst,jobs,machines",
     [(14, 20, 10), (1, 12, 5)],
 )
-def test_lb1_d_bounds_match_oracle(inst, jobs, machines):
+def test_lb1_d_bounds_match_oracle(inst, jobs, machines, bf16):
     rng = np.random.default_rng(11)
     if jobs == 20:
         prob = PFSPProblem(inst=inst, lb="lb1_d", ub=1)
@@ -125,6 +128,6 @@ def test_lb1_d_bounds_match_oracle(inst, jobs, machines):
     )
     got = pallas_kernels.pfsp_lb1_d_bounds(
         jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads, t.min_tails,
-        interpret=True,
+        interpret=True, bf16=bf16,
     )
     assert np.array_equal(np.asarray(oracle), np.asarray(got))

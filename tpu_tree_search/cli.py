@@ -52,7 +52,8 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_argument(
         "--D", type=int, default=None,
         help="number of devices/shards (mesh, multi, dist tiers); "
-        "default: all local devices",
+        "default: all local devices. With --mp N this is the dp-axis "
+        "size and the run consumes D*mp devices",
     )
     common.add_argument(
         "--mp", type=int, default=1,

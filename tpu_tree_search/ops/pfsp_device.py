@@ -84,17 +84,15 @@ def gather_ptimes(prmu, ptm_t, exact_bf16: bool = False):
     """
     n = prmu.shape[-1]
     if n <= 32:
-        if exact_bf16:
-            oh = jax.nn.one_hot(prmu, n, dtype=jnp.bfloat16)
-            return jnp.einsum(
-                "bkj,jm->bkm", oh, ptm_t.astype(jnp.bfloat16),
-                preferred_element_type=jnp.float32,
-            ).astype(jnp.int32)
-        oh = jax.nn.one_hot(prmu, n, dtype=jnp.float32)  # (B, n, n)
+        dt = jnp.bfloat16 if exact_bf16 else jnp.float32
+        # f32 needs HIGHEST (the TPU default single bf16 pass would round
+        # ints > 256); the gated bf16 single pass is already exact.
+        prec = None if exact_bf16 else jax.lax.Precision.HIGHEST
+        oh = jax.nn.one_hot(prmu, n, dtype=dt)  # (B, n, n)
         return jnp.einsum(
-            "bkj,jm->bkm", oh, ptm_t.astype(jnp.float32),
+            "bkj,jm->bkm", oh, ptm_t.astype(dt),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,  # TPU default is bf16-pass
+            precision=prec,
         ).astype(jnp.int32)
     return ptm_t[prmu]
 
