@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="dist tier: disable inter-host stealing + "
                         "incumbent exchange (MPI-baseline join-point-only "
                         "semantics)")
+    common.add_argument("--distributed", action="store_true",
+                        help="dist tier, real pods: call "
+                        "jax.distributed.initialize() before searching "
+                        "(coordinator/process env supplied by the launcher, "
+                        "e.g. GKE/TPU-VM metadata — the -nl/MPI launcher "
+                        "analogue)")
     common.add_argument("--profile", type=str, default=None,
                         help="write a jax profiler trace of the search to "
                         "this directory (view with TensorBoard/XProf)")
@@ -113,8 +119,15 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
         parser.error(
             "--perc only applies to the work-stealing tiers (multi, dist)"
         )
-    if (args.hosts is not None or args.no_steal) and args.tier != "dist":
-        parser.error("--hosts/--no-steal only apply to --tier dist")
+    if (
+        args.hosts is not None or args.no_steal or args.distributed
+    ) and args.tier != "dist":
+        parser.error(
+            "--hosts/--no-steal/--distributed only apply to --tier dist"
+        )
+    if args.distributed and args.hosts is not None:
+        parser.error("--distributed (real pods) and --hosts (virtual "
+                     "hosts) are mutually exclusive")
     if args.hosts is not None and args.hosts < 1:
         parser.error("--hosts must be >= 1")
     if args.mp != 1:
@@ -315,13 +328,23 @@ def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     validate_args(parser, args)
+    primary = True
+    if args.distributed:
+        # Must run before ANY jax call that initializes backends (including
+        # the profiler's trace session). Coordinator/process ids come from
+        # the launcher's environment (the -nl / mpirun analogue).
+        import jax
+
+        jax.distributed.initialize()
+        primary = jax.process_index() == 0
     enable_compile_cache()
     try:
         problem = make_problem(args)
     except ValueError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 2
-    print_settings(args)
+    if primary:
+        print_settings(args)
     try:
         if args.profile:
             # Trace the whole search (phase timers remain the first-class
@@ -335,15 +358,19 @@ def main(argv=None) -> int:
     except (ModuleNotFoundError, NotImplementedError) as e:
         print(f"Error: tier '{args.tier}' unavailable: {e}", file=sys.stderr)
         return 2
-    print_results(args, problem, res)
-    rec = result_record(args, res)
-    if args.json:
-        print(json.dumps(rec))
-    if args.stats_file:
-        # Append-only stats line, like `stats_pfsp_gpu_cuda.dat`
-        # (`pfsp_gpu_cuda.c:140-148`).
-        with open(args.stats_file, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+    # Multi-process pods: every host computed the same reduced result;
+    # report from process 0 only (the MPI baseline's rank-0 stats line,
+    # `pfsp_dist_multigpu_cuda.c:179-187`).
+    if primary:
+        print_results(args, problem, res)
+        rec = result_record(args, res)
+        if args.json:
+            print(json.dumps(rec))
+        if args.stats_file:
+            # Append-only stats line, like `stats_pfsp_gpu_cuda.dat`
+            # (`pfsp_gpu_cuda.c:140-148`).
+            with open(args.stats_file, "a") as f:
+                f.write(json.dumps(rec) + "\n")
     return 0
 
 
