@@ -1,0 +1,67 @@
+"""CLI surface tests: flag parsing/validation, banner + JSON + stats-file
+output, golden counts through ``main()`` — the reference's per-main
+`check_parameters`/`print_results` behavior (`pfsp_chpl.chpl:42-77`)
+centralized in one program."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_tree_search import cli
+
+
+def _last_json(out: str) -> dict:
+    return json.loads(out.strip().splitlines()[-1])
+
+
+def test_seq_json_golden(capsys):
+    assert cli.main(["nqueens", "--N", "8", "--json"]) == 0
+    rec = _last_json(capsys.readouterr().out)
+    assert (rec["explored_tree"], rec["explored_sol"]) == (2056, 92)
+    assert rec["tier"] == "seq"
+
+
+def test_device_tier_banner_and_stats(tmp_path, capsys):
+    stats = tmp_path / "stats.dat"
+    assert cli.main([
+        "nqueens", "--N", "8", "--tier", "device", "--m", "5", "--M", "64",
+        "--stats-file", str(stats),
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "Single-device TPU tree search" in out
+    assert "Size of the explored tree: 2056" in out
+    rec = json.loads(stats.read_text().strip())
+    assert rec["explored_sol"] == 92
+
+
+def test_pfsp_banner_reports_makespan(capsys):
+    # Full Taillard searches take minutes on CPU; a --max-steps cutoff still
+    # exercises the whole banner path (settings, interruption notice, and
+    # the ub=1 makespan line).
+    assert cli.main([
+        "pfsp", "--inst", "1", "--lb", "lb1", "--tier", "device",
+        "--m", "5", "--M", "512", "--K", "2", "--max-steps", "2",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "Taillard's instance: ta001" in out
+    assert "Exploration interrupted" in out
+    assert "Optimal makespan: 1278 (not improved)" in out
+
+
+@pytest.mark.parametrize("argv,msg", [
+    (["nqueens", "--tier", "mesh", "--engine", "offload"], "resident-only"),
+    (["nqueens", "--tier", "seq", "--perc", "0.3"], "--perc only applies"),
+    (["nqueens", "--tier", "seq", "--hosts", "2"], "only apply to --tier dist"),
+    (["nqueens", "--tier", "dist", "--hosts", "0"], "--hosts must be >= 1"),
+    (["nqueens", "--tier", "seq", "--mp", "2"], "--mp only applies"),
+    (["pfsp", "--tier", "mesh", "--lb", "lb1", "--mp", "2"], "lb2 Johnson"),
+    (["nqueens", "--tier", "dist", "--distributed", "--hosts", "2"],
+     "mutually exclusive"),
+])
+def test_flag_validation(argv, msg, capsys):
+    with pytest.raises(SystemExit) as e:
+        cli.main(argv)
+    assert e.value.code == 2
+    assert msg in capsys.readouterr().err

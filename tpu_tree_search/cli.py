@@ -263,6 +263,8 @@ def print_results(args, problem, res) -> None:
             f"Device diagnostics: kernel_launch={d.kernel_launches} "
             f"host_to_device={d.host_to_device} device_to_host={d.device_to_host}"
         )
+    if res.steals:
+        print(f"Work steals (intra-host): {res.steals}")
     if res.comm:
         c = res.comm
         print(
@@ -283,6 +285,10 @@ def result_record(args, res) -> dict:
     }
     if not res.complete:
         rec["complete"] = False
+    if res.steals:
+        rec["steals"] = res.steals
+    if res.comm:
+        rec["comm"] = res.comm
     if args.problem == "pfsp":
         rec.update(inst=args.inst, lb=args.lb, ub=args.ub, optimum=res.best)
     else:
@@ -335,7 +341,16 @@ def main(argv=None) -> int:
         # the launcher's environment (the -nl / mpirun analogue).
         import jax
 
-        jax.distributed.initialize()
+        try:
+            jax.distributed.initialize()
+        except Exception as e:
+            print(
+                f"Error: jax.distributed.initialize() failed: {e}\n"
+                "(--distributed needs the launcher to supply coordinator/"
+                "process environment)",
+                file=sys.stderr,
+            )
+            return 2
         primary = jax.process_index() == 0
     enable_compile_cache()
     try:

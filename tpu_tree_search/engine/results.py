@@ -44,6 +44,10 @@ class SearchResult:
     # False when the run stopped early (max_steps cutoff) and saved a
     # checkpoint instead of finishing; counters cover work done so far.
     complete: bool = True
+    # multi/dist tiers: successful intra-host work steals (the reference
+    # declares nSteal counters but never reports them,
+    # `pfsp_multigpu_chpl.chpl:380`).
+    steals: int = 0
     # dist tier: inter-host communicator totals (exchange rounds, stolen
     # blocks/nodes), summed across hosts.
     comm: dict | None = None

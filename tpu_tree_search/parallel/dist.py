@@ -363,6 +363,7 @@ def _reduce(local: dict, collectives) -> SearchResult:
     sol = collectives.allreduce_sum(local["sol"])
     best = collectives.allreduce_min(local["best"])
     elapsed = collectives.allreduce_max(local["elapsed"])
+    steals = collectives.allreduce_sum(local["steals"])
     comm = None
     if "comm" in local:
         comm = {
@@ -376,6 +377,7 @@ def _reduce(local: dict, collectives) -> SearchResult:
         phases=local["phases"],
         diagnostics=local["diag"],
         per_worker_tree=local["per_worker_tree"],
+        steals=steals,
         comm=comm,
     )
 

@@ -316,6 +316,7 @@ def host_pipeline(
         "tree": tree1 + tree2 + tree3,
         "sol": sol1 + sol2 + sol3,
         "best": best,
+        "steals": sum(w.steals for w in workers),
         "phases": [
             PhaseStats(t1 - t0, tree1, sol1),
             PhaseStats(t2 - t1, tree2, sol2),
@@ -354,4 +355,5 @@ def multidevice_search(
         phases=local["phases"],
         diagnostics=local["diag"],
         per_worker_tree=local["per_worker_tree"],
+        steals=local["steals"],
     )
