@@ -1,0 +1,62 @@
+"""Randomized cross-tier equivalence: every tier must reproduce the
+sequential tier's exploredTree/exploredSol EXACTLY under a fixed incumbent,
+on randomly generated instances — chunking, work stealing, diffusion
+balancing, and mp-sharding may only permute visit order (SURVEY.md §4.2's
+determinism invariant, fuzzed instead of fixed-instance)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine.device import device_search
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.engine.sequential import sequential_search
+from tpu_tree_search.parallel.dist import dist_search
+from tpu_tree_search.parallel.multidevice import multidevice_search
+from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
+from tpu_tree_search.problems import PFSPProblem
+
+
+@pytest.mark.parametrize(
+    "seed,lb", [(11, "lb1"), (23, "lb1_d"), (47, "lb2")]
+)
+def test_all_tiers_match_sequential_on_random_instance(seed, lb):
+    rng = np.random.default_rng(seed)
+    jobs = int(rng.integers(6, 9))
+    machines = int(rng.integers(3, 6))
+    ptm = np.ascontiguousarray(
+        rng.integers(1, 100, size=(machines, jobs)).astype(np.int32)
+    )
+
+    def mk():
+        return PFSPProblem(lb=lb, ub=0, p_times=ptm)
+
+    # Fixed incumbent: solve once with ub=0, then pin every tier to the
+    # optimum (the ub=1 regime of the reference's validity check).
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    golden = (seq.explored_tree, seq.explored_sol)
+
+    results = {
+        "device": device_search(mk(), m=4, M=64, initial_best=opt),
+        "resident": resident_search(mk(), m=4, M=64, K=8, initial_best=opt),
+        "mesh": mesh_resident_search(
+            mk(), m=4, M=64, K=4, rounds=2, D=4, initial_best=opt
+        ),
+        "multi": multidevice_search(mk(), m=4, M=64, D=3, initial_best=opt),
+        "dist": dist_search(
+            mk(), m=4, M=64, D=2, num_hosts=2, initial_best=opt,
+            steal_interval_s=0.005,
+        ),
+    }
+    if lb == "lb2":
+        results["mesh_mp"] = mesh_resident_search(
+            mk(), m=4, M=64, K=4, rounds=2, D=4, mp=2, initial_best=opt
+        )
+    for tier, res in results.items():
+        assert (res.explored_tree, res.explored_sol) == golden, (
+            f"{tier} diverged on seed={seed} jobs={jobs} machines={machines} "
+            f"lb={lb}: {(res.explored_tree, res.explored_sol)} != {golden}"
+        )
+        assert res.best == opt
