@@ -80,6 +80,34 @@ print("PALLAS_PROBE_OK")
 """
 
 
+def backend_alive(timeout_s: float = 240.0) -> tuple[bool, str | None]:
+    """One tiny matmul in a subprocess: a dead TPU tunnel hangs backend
+    init forever (observed: multi-hour axon outages), and a hang in the
+    parent would eat the driver's whole budget without even printing the
+    JSON line. Subprocess + timeout turns that into a clean error record.
+    Returns (ok, error) with a crash's stderr tail preserved."""
+    code = (
+        "import jax, jax.numpy as jnp; "
+        "x = jnp.ones((8, 8)); print(float((x @ x).sum()))"
+    )
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, (
+            f"jax backend unreachable: device init hung for {timeout_s:.0f}s "
+            "(tunnel down?)"
+        )
+    if res.returncode != 0:
+        tail = (res.stderr or res.stdout).strip().splitlines()[-3:]
+        return False, "jax backend init crashed: " + " | ".join(tail)
+    return True, None
+
+
 def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None]:
     """Compile + oracle-check the PFSP Pallas kernels in a subprocess.
 
@@ -128,6 +156,20 @@ def main() -> int:
     from tpu_tree_search.cli import enable_compile_cache
 
     enable_compile_cache()
+
+    alive, alive_err = backend_alive()
+    if not alive:
+        print(json.dumps({
+            "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "nodes/sec",
+            "vs_baseline": 0.0,
+            "parity": False,
+            "error": alive_err,
+            "pallas": False,
+            "extra": [],
+        }))
+        return 1
 
     pallas_ok, pallas_err = probe_pallas()
     if not pallas_ok:
