@@ -29,15 +29,22 @@ def test_nqueens_parity():
 
 
 def test_nqueens_overflow_fallback():
-    # Tiny capacity forces the capacity-stall path (host offload cycles) and
-    # the in-step survivor-budget overflow branch; counts must not change.
+    # A warm frontier beyond the fan-out headroom forces the capacity-stall
+    # path (host offload cycles until the pool fits again), and M=256 makes
+    # breadth chunks exceed the survivor budget (S = M*n/2), covering the
+    # full-scatter overflow branch; counts must not change.
     prob = NQueensProblem(N=11)
     seq = sequential_search(prob)
-    res = resident_search(prob, m=8, M=64, K=16, capacity=6000)
+    res = resident_search(
+        prob, m=8, M=256, K=16, capacity=8000, warmup_target=7500
+    )
     assert (res.explored_tree, res.explored_sol) == (
         seq.explored_tree,
         seq.explored_sol,
     )
+    # The stall path's offloader transfers must appear in the diagnostics.
+    assert res.diagnostics.host_to_device > 1
+    assert res.diagnostics.device_to_host > 1
 
 
 @pytest.mark.parametrize("lb", ["lb1", "lb1_d", "lb2"])

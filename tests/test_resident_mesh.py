@@ -55,15 +55,26 @@ def test_pfsp_improving_incumbent_pmin():
 
 
 def test_saturation_fallback():
-    # A capacity far too small for the frontier forces the host-offload
-    # saturation fallback; counts must survive the round trips.
-    prob = NQueensProblem(N=11)
+    # Genuine all-shard saturation: warm up to a frontier (1000+ nodes per
+    # shard) that exceeds every shard's fan-out headroom (capacity 1500 -
+    # M*n = ~800) while no shard starves, so diffusion moves nothing and
+    # the step makes zero cycles — the host-offload fallback must engage
+    # and counts must survive the round trips.
+    prob = NQueensProblem(N=12)
     seq = sequential_search(prob)
-    res = mesh_resident_search(prob, m=8, M=64, K=4, rounds=1, capacity=3000)
+    res = mesh_resident_search(
+        prob, m=8, M=64, K=4, rounds=1, capacity=1500, warmup_target=8000
+    )
     assert (res.explored_tree, res.explored_sol) == (
         seq.explored_tree,
         seq.explored_sol,
     )
+    # The fallback's offloader transfers must be merged into the result's
+    # diagnostics, not dropped (round-1 advisor finding c): every fallback
+    # chunk is one H2D + one D2H on top of the pool re-uploads.
+    d = res.diagnostics
+    assert d.host_to_device > 1
+    assert d.device_to_host >= d.host_to_device - 1
 
 
 def test_single_device_mesh_degenerates():
