@@ -345,17 +345,20 @@ def _lb2_kernel(
         jord = jorder_ref[q]  # (n, n) slot-order one-hot
         # u_o[b, k, t] = u_child[b, k, sched_q[t]]
         u_o = hp(u_child.reshape(T * n, n), jord.T, bf16).reshape(T, n, n)
-        p0 = p0_ref[q].astype(jnp.float32)  # (n,)
-        p1 = p1_ref[q].astype(jnp.float32)
-        lag = lag_ref[q].astype(jnp.float32)
+        # Per-pair tables are (P, 1, n): the dynamic q index must hit a
+        # non-tiled leading axis (a (P, n) ref would put it on the sublane
+        # dim, which Mosaic cannot index dynamically).
+        p0 = p0_ref[q][0].astype(jnp.float32)  # (n,)
+        p1 = p1_ref[q][0].astype(jnp.float32)
+        lag = lag_ref[q][0].astype(jnp.float32)
         mp0 = u_o * p0[None, None, :]
         mp1 = u_o * p1[None, None, :]
         # Machine selection as a one-hot contraction on the lane axis —
         # Mosaic cannot dynamic_slice a VMEM *value* along a lane dim, but a
         # masked reduction against the precomputed (P, m) selector rows is
         # exact (0/1 mask) and pure VPU work.
-        s0 = msel0_ref[q].astype(jnp.float32)  # (m,)
-        s1 = msel1_ref[q].astype(jnp.float32)
+        s0 = msel0_ref[q][0].astype(jnp.float32)  # (m,)
+        s1 = msel1_ref[q][0].astype(jnp.float32)
         tmp0_0 = jnp.sum(child_front * s0[None, None, :], axis=-1)  # (T, n)
         tmp1_0 = jnp.sum(child_front * s1[None, None, :], axis=-1)
         cum0 = hp(mp0.reshape(T * n, n), tri_incl, bf16).reshape(T, n, n)
@@ -380,6 +383,7 @@ def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool,
     kernel = partial(_lb2_kernel, n=n, m=m, P=P, bf16=bf16)
     grid = (B // tile,)
     full = lambda i: (0, 0)
+    full3 = lambda i: (0, 0, 0)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B, n), jnp.int32),
@@ -389,17 +393,19 @@ def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool,
             pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, n), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, n), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, n), full, memory_space=pltpu.VMEM),
+            # Per-pair tables as (P, 1, n)/(P, 1, m): leading-axis dynamic
+            # ref reads (see pair_body).
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
             # Per-pair scalars read with a dynamic index: SMEM (Mosaic cannot
             # dynamically index 1-D VMEM along the lane dim).
             pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
             pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
-            # (P, m) one-hot machine selectors (rows read per pair).
-            pl.BlockSpec((P, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, n, n), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+            # (P, 1, m) one-hot machine selectors (rows read per pair).
+            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, n, n), full3, memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
@@ -426,13 +432,13 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
         limit1.astype(jnp.int32)[:, None],
         tables.ptm_t,
         tables.min_heads[None, :],
-        ordered.p0_o,
-        ordered.p1_o,
-        ordered.lag_o,
+        ordered.p0_o[:, None, :],
+        ordered.p1_o[:, None, :],
+        ordered.lag_o[:, None, :],
         ordered.tails0,
         ordered.tails1,
-        ordered.msel0,
-        ordered.msel1,
+        ordered.msel0[:, None, :],
+        ordered.msel1[:, None, :],
         ordered.jorder,
     )
     return out[:B]
