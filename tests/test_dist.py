@@ -144,3 +144,42 @@ def test_dist_terminates_with_drain_leftovers():
     )
     assert ds.explored_tree == seq.explored_tree
     assert ds.explored_sol == seq.explored_sol
+
+
+def test_jax_collectives_single_process_subprocess():
+    """JaxCollectives (the real-pod DCN backend) exercised end to end in a
+    1-process jax.distributed universe — run in a subprocess because
+    jax.distributed.initialize is once-per-process and would leak into the
+    rest of the suite."""
+    import subprocess
+    import sys
+
+    code = """
+import os
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize("localhost:19793", num_processes=1, process_id=0)
+from tpu_tree_search.parallel.dist import JaxCollectives, dist_search
+from tpu_tree_search.problems import NQueensProblem
+from tpu_tree_search.engine.sequential import sequential_search
+
+coll = JaxCollectives()
+assert coll.num_hosts == 1 and coll.host_id == 0
+assert coll.allreduce_sum(7) == 7
+assert coll.allreduce_min(3.5) == 3.5
+got = coll.allgather_obj({"blob": list(range(5))})
+assert got == [{"blob": [0, 1, 2, 3, 4]}]
+
+seq = sequential_search(NQueensProblem(N=8))
+res = dist_search(NQueensProblem(N=8), m=5, M=64)
+assert res.explored_sol == seq.explored_sol
+assert res.explored_tree == seq.explored_tree
+print("JAX_COLLECTIVES_OK")
+"""
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240,
+    )
+    assert "JAX_COLLECTIVES_OK" in res.stdout, res.stderr[-2000:]
