@@ -90,6 +90,57 @@ def test_checkpoint_refuses_different_ptimes(tmp_path):
         ckpt.load(path, prob_b)
 
 
+def test_checkpoint_accepts_v1_when_meta_matches(tmp_path):
+    """A v1 checkpoint (no ptimes_sha digest) must still resume when every
+    other meta field matches — v1 NQueens/named-instance metas are
+    unambiguous (ADVICE r3). A v1 meta that disagrees still refuses."""
+    import json
+
+    import numpy as np
+
+    def save_as_v1(path, problem, batch):
+        meta = {k: v for k, v in ckpt.problem_meta(problem).items()
+                if k != "ptimes_sha"}
+        header = {
+            "version": 1, "meta": meta, "best": 10**9, "tree": 5, "sol": 1,
+            "fields": sorted(batch.keys()),
+        }
+        arrays = {f"field_{k}": v for k, v in batch.items()}
+        with open(path, "wb") as f:
+            np.savez_compressed(
+                f,
+                header=np.frombuffer(
+                    json.dumps(header).encode(), dtype=np.uint8
+                ),
+                **arrays,
+            )
+
+    prob = PFSPProblem(inst=14)
+    path = str(tmp_path / "v1.ckpt")
+    save_as_v1(path, prob, prob.root())
+    loaded = ckpt.load(path, prob)
+    assert loaded.tree == 5 and loaded.sol == 1
+
+    with pytest.raises(ValueError, match="checkpoint is for"):
+        ckpt.load(path, PFSPProblem(inst=15))
+
+    qpath = str(tmp_path / "v1q.ckpt")
+    qprob = NQueensProblem(N=9)
+    save_as_v1(qpath, qprob, qprob.root())
+    assert ckpt.load(qpath, qprob).tree == 5
+    with pytest.raises(ValueError, match="checkpoint is for"):
+        ckpt.load(qpath, NQueensProblem(N=10))
+
+    # Ad-hoc PFSP matrices have no v1-expressible identity (two different
+    # matrices of the same shape would be indistinguishable) — refuse.
+    apath = str(tmp_path / "v1adhoc.ckpt")
+    ptm = taillard.reduced_instance(14, jobs=6, machines=4)
+    aprob = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    save_as_v1(apath, aprob, aprob.root())
+    with pytest.raises(ValueError, match="ad-hoc"):
+        ckpt.load(apath, aprob)
+
+
 def test_resolve_capacity_grows_for_chunk_floor():
     """A tiny explicit capacity must grow to fit the 64-chunk floor rather
     than leave M*n > capacity/2, which would starve the device loop and

@@ -59,6 +59,9 @@ def test_pfsp_banner_reports_makespan(capsys):
     (["pfsp", "--tier", "mesh", "--lb", "lb1", "--mp", "2"], "lb2 Johnson"),
     (["nqueens", "--tier", "dist", "--distributed", "--hosts", "2"],
      "mutually exclusive"),
+    (["nqueens", "--tier", "multi", "--perc", "1.5"], "in (0, 1]"),
+    (["nqueens", "--tier", "multi", "--perc", "0"], "in (0, 1]"),
+    (["nqueens", "--tier", "multi", "--perc", "-0.25"], "in (0, 1]"),
 ])
 def test_flag_validation(argv, msg, capsys):
     with pytest.raises(SystemExit) as e:

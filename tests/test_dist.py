@@ -146,6 +146,95 @@ def test_dist_terminates_with_drain_leftovers():
     assert ds.explored_sol == seq.explored_sol
 
 
+def test_thread_collectives_kv_channel():
+    """kv_set/kv_get: the point-to-point donation channel (payloads never
+    broadcast to non-receivers)."""
+    import threading
+
+    coll = ThreadCollectives(2)
+    got = {}
+
+    def sender():
+        coll.bind(0)
+        coll.kv_set("tts/steal/1/0->1", b"payload-bytes")
+
+    def receiver():
+        coll.bind(1)
+        got["v"] = coll.kv_get("tts/steal/1/0->1", timeout_s=5.0)
+
+    ts = [threading.Thread(target=receiver), threading.Thread(target=sender)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert got["v"] == b"payload-bytes"
+    assert coll._kv == {}  # consumed, nothing left behind
+
+
+def test_thread_collectives_kv_get_timeout():
+    coll = ThreadCollectives(1)
+    coll.bind(0)
+    with pytest.raises(TimeoutError):
+        coll.kv_get("missing", timeout_s=0.1)
+
+
+def test_pop_front_bulk_half_cap():
+    """Donation blocks are capped so a huge pool never ships an unbounded
+    payload (VERDICT r3 weak #1; the mesh tier's bounded-donation policy)."""
+    import numpy as np
+
+    from tpu_tree_search.pool import SoAPool
+
+    p = SoAPool({"x": ((), np.int32)})
+    p.push_back_bulk({"x": np.arange(10000, dtype=np.int32)})
+    batch = p.pop_front_bulk_half(m=5, perc=0.5, cap=64)
+    assert batch["x"].shape[0] == 64
+    assert list(batch["x"][:3]) == [0, 1, 2]  # still from the front
+    assert p.size == 10000 - 64
+    # uncapped keeps the steal-half policy
+    batch2 = p.pop_front_bulk_half(m=5, perc=0.5)
+    assert batch2["x"].shape[0] == (10000 - 64) // 2
+
+
+def test_skewed_partition_donations_bounded():
+    """Integration: with one starved host and a tiny M, every delivered
+    donation block respects the M cap (sum over blocks <= blocks * M)."""
+
+    def all_to_host0(warm, host_id, num_hosts):
+        if host_id == 0:
+            return warm
+        return {k: v[:0] for k, v in warm.items()}
+
+    M = 32
+    ds = dist_search(
+        NQueensProblem(N=10), m=5, M=M, D=2, num_hosts=2,
+        steal_interval_s=0.005, partition_fn=all_to_host0,
+    )
+    seq = sequential_search(NQueensProblem(N=10))
+    assert ds.explored_tree == seq.explored_tree
+    assert ds.comm is not None and ds.comm["blocks_received"] > 0
+    assert ds.comm["nodes_received"] <= ds.comm["blocks_received"] * M
+    assert ds.comm["nodes_sent"] == ds.comm["nodes_received"]
+
+
+def test_balanced_run_cadence_backs_off():
+    """When no host is needy the exchange cadence backs off geometrically
+    (VERDICT r3 weak #4): a balanced run must do far fewer collective rounds
+    than the fixed-interval cadence would."""
+    interval = 0.002
+    ds = dist_search(
+        NQueensProblem(N=10), m=5, M=2048, D=2, num_hosts=2,
+        steal_interval_s=interval,
+    )
+    seq = sequential_search(NQueensProblem(N=10))
+    assert ds.explored_tree == seq.explored_tree
+    fixed_cadence_rounds = ds.elapsed / interval
+    assert ds.comm["rounds"] < max(10.0, fixed_cadence_rounds / 2), (
+        ds.comm,
+        ds.elapsed,
+    )
+
+
 def test_jax_collectives_single_process_subprocess():
     """JaxCollectives (the real-pod DCN backend) exercised end to end in a
     1-process jax.distributed universe — run in a subprocess because
@@ -171,6 +260,8 @@ assert coll.allreduce_sum(7) == 7
 assert coll.allreduce_min(3.5) == 3.5
 got = coll.allgather_obj({"blob": list(range(5))})
 assert got == [{"blob": [0, 1, 2, 3, 4]}]
+coll.kv_set("tts/steal/7/0->0", b"kv-bytes")
+assert coll.kv_get("tts/steal/7/0->0", timeout_s=5.0) == b"kv-bytes"
 
 seq = sequential_search(NQueensProblem(N=8))
 res = dist_search(NQueensProblem(N=8), m=5, M=64)

@@ -119,6 +119,11 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
         parser.error(
             "--perc only applies to the work-stealing tiers (multi, dist)"
         )
+    if not 0.0 < args.perc <= 1.0:
+        parser.error(
+            "--perc must be in (0, 1]: the fraction of the victim's front "
+            "taken per steal (`Pool_ext.c:138-151`)"
+        )
     if (
         args.hosts is not None or args.no_steal or args.distributed
     ) and args.tier != "dist":

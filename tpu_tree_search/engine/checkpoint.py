@@ -117,9 +117,25 @@ def save(path: str, problem: Problem, batch: NodeBatch, best: int, tree: int, so
 def load(path: str, problem: Problem) -> Checkpoint:
     with np.load(path) as data:
         header = json.loads(bytes(data["header"]).decode())
-        if header["version"] != FORMAT_VERSION:
+        if header["version"] not in (1, FORMAT_VERSION):
             raise ValueError(f"unsupported checkpoint version {header['version']}")
-        if header["meta"] != problem_meta(problem):
+        want = problem_meta(problem)
+        got = dict(header["meta"])
+        if header["version"] == 1:
+            # v1 predates the p_times digest; its remaining meta fields
+            # (problem/N/g or inst/lb/ub/jobs/machines) are unambiguous for
+            # NQueens and *named* Taillard instances — accept those with the
+            # digest treated as advisory. Ad-hoc PFSP matrices (inst=None)
+            # stay rejected: without the digest two different matrices of
+            # the same shape are indistinguishable.
+            if want["problem"] != "nqueens" and want.get("inst") is None:
+                raise ValueError(
+                    "v1 checkpoint cannot identify an ad-hoc PFSP instance "
+                    "(no p_times digest); re-run from scratch"
+                )
+            want = {k: v for k, v in want.items() if k != "ptimes_sha"}
+            got.pop("ptimes_sha", None)
+        if got != want:
             raise ValueError(
                 f"checkpoint is for {header['meta']}, not {problem_meta(problem)}"
             )

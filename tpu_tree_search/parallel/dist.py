@@ -61,6 +61,16 @@ class LocalCollectives:
     def allgather_obj(self, value) -> list:
         return [value]
 
+    def kv_set(self, key: str, value: bytes) -> None:
+        self._kv = getattr(self, "_kv", {})
+        self._kv[key] = value
+
+    def kv_get(self, key: str, timeout_s: float) -> bytes:
+        try:
+            return getattr(self, "_kv", {}).pop(key)
+        except KeyError:
+            raise TimeoutError(f"kv_get({key!r}): no such key") from None
+
 
 class ThreadCollectives:
     """In-process collectives for H virtual hosts running in threads (the
@@ -73,6 +83,8 @@ class ThreadCollectives:
         self._lock = threading.Lock()
         self._values: list = [None] * num_hosts
         self._local = threading.local()
+        self._kv: dict = {}
+        self._kv_cond = threading.Condition()
 
     def bind(self, host_id: int):
         """Each participating thread binds its host id once."""
@@ -101,6 +113,27 @@ class ThreadCollectives:
 
     def allgather_obj(self, value) -> list:
         return self._exchange(value)
+
+    def kv_set(self, key: str, value: bytes) -> None:
+        with self._kv_cond:
+            self._kv[key] = value
+            self._kv_cond.notify_all()
+
+    def kv_get(self, key: str, timeout_s: float) -> bytes:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        with self._kv_cond:
+            while key not in self._kv:
+                # Short wait slices so an aborted peer (broken barrier)
+                # is noticed promptly even though aborts don't notify us.
+                if self._barrier.broken or _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"kv_get({key!r}) timed out"
+                        + (" (peer aborted)" if self._barrier.broken else "")
+                    )
+                self._kv_cond.wait(timeout=0.05)
+            return self._kv.pop(key)
 
 
 class JaxCollectives:
@@ -133,8 +166,9 @@ class JaxCollectives:
 
     def allgather_obj(self, value) -> list:
         """Arbitrary-object allgather over DCN: two rounds (lengths, then a
-        max-length-padded byte buffer). Node blocks are a few hundred KB at
-        most (<= M nodes x ~24 bytes), well within DCN message sizes."""
+        max-length-padded byte buffer). Only small control tuples travel this
+        way — node payloads go point-to-point via the KV store (``kv_set`` /
+        ``kv_get``), never broadcast."""
         import pickle
 
         from jax.experimental import multihost_utils
@@ -156,6 +190,36 @@ class JaxCollectives:
             for h in range(self.num_hosts)
         ]
 
+    @staticmethod
+    def _client():
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed KV store unavailable (initialize() not "
+                "called?)"
+            )
+        return client
+
+    def kv_set(self, key: str, value: bytes) -> None:
+        """Point-to-point donation delivery over the jax.distributed
+        coordination service's KV store — the DCN analogue of the CUDA
+        baseline's point-to-point steal (`Pool_ext.c:138-151`); non-receivers
+        never see the payload (vs. the broadcast allgather)."""
+        self._client().key_value_set_bytes(key, value, allow_overwrite=True)
+
+    def kv_get(self, key: str, timeout_s: float) -> bytes:
+        client = self._client()
+        data = client.blocking_key_value_get_bytes(
+            key, int(timeout_s * 1000)
+        )
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass  # cleanup is best-effort; keys are round-unique
+        return data
+
 
 class _HostComm:
     """Per-host communicator: periodic cross-host incumbent exchange,
@@ -173,23 +237,40 @@ class _HostComm:
       3. rich hosts (size >= 2m) are deterministically matched to starving
          idle hosts (same gathered data on every host -> same matching, no
          handshake); each donor locks its fullest local pool and pops half
-         its *front* (`Pool_par.chpl:180-191` policy), and a second
-         allgather delivers the blocks;
-      4. a round with all hosts idle, no donations, and only drain-sized
-         leftovers ends the loop everywhere at once (two-level
-         termination, `pfsp_dist_multigpu_chpl.chpl:569-587`); local
+         its *front* (`Pool_par.chpl:180-191` policy) **capped at M nodes**
+         (the mesh tier's bounded-donation policy,
+         `resident_mesh.py` diffusion cap), and delivers the block
+         *point-to-point* through the collectives' KV channel — only the
+         matched receiver ever sees the payload (the CUDA baseline's
+         point-to-point steal semantics, `Pool_ext.c:138-151`, vs. a
+         broadcast);
+      4. two consecutive rounds with all hosts idle, no donations, and only
+         drain-sized leftovers end the loop everywhere at once (two-level
+         termination, `pfsp_dist_multigpu_chpl.chpl:569-587`; the second
+         round re-samples pool sizes so a momentarily-between-polls worker
+         can't divert poppable work to the serial host drain); local
          workers then exit via ``stop_event`` and the per-host drain picks
          up any sub-chunk remainder, so no work is ever lost.
+
+    When every host is busy and none is needy, the exchange cadence backs
+    off geometrically (up to 16x ``interval_s``) and resets the moment any
+    host reports need — a balanced run pays almost no collective overhead.
     """
 
+    #: kv_get wait for a matched donation (donor is alive and popping from
+    #: a local pool; seconds would indicate a dead peer -> fail-stop).
+    KV_TIMEOUT_S = 120.0
+    BACKOFF_MAX = 16  # cadence back-off cap (x interval_s)
+
     def __init__(self, collectives, m: int, perc: float = 0.5,
-                 interval_s: float = 0.02):
+                 interval_s: float = 0.02, M: int = 50000):
         self.coll = collectives
         # Captured here (construction happens on the bound host thread):
         # ThreadCollectives.host_id is thread-local and the communicator
         # runs in its own thread, which re-binds with this value.
         self.me = collectives.host_id
         self.m = m
+        self.M = M
         self.perc = perc
         self.interval_s = interval_s
         self.rounds = 0
@@ -202,14 +283,17 @@ class _HostComm:
 
     def _donate_from(self, pools):
         """Locked front-steal from the fullest local pool (on behalf of a
-        remote host); None when no pool can spare a block."""
+        remote host); None when no pool can spare a block. Blocks are capped
+        at M nodes so a huge pool never ships an unbounded payload over DCN
+        (the reference steals perc-of-pool uncapped, `Pool_ext.c:138-151`;
+        the mesh tier here caps donations — same policy)."""
         victim = max(pools, key=lambda p: p.size)
         if victim.size < 2 * self.m:
             return None
         if not victim.try_lock():
             return None
         try:
-            return victim.pop_front_bulk_half(self.m, self.perc)
+            return victim.pop_front_bulk_half(self.m, self.perc, cap=self.M)
         finally:
             victim.unlock()
 
@@ -240,16 +324,19 @@ class _HostComm:
                     pass
 
     def _loop(self, pools, states, shared, stop_event):
+        import pickle
         import time as _time
 
         coll = self.coll
         H = coll.num_hosts
         me = self.me
         rrobin = 0
+        backoff = 1  # cadence multiplier (adaptive back-off)
+        quiescent_streak = 0
         from ..problems.base import batch_length
 
         while True:
-            _time.sleep(self.interval_s)
+            _time.sleep(self.interval_s * backoff)
             if states.flag.is_set():  # a worker died: abort everywhere
                 stop_event.set()
                 abort = getattr(coll, "_barrier", None)
@@ -284,26 +371,52 @@ class _HostComm:
             pairs = list(zip(donors, needy))
             if not pairs:
                 if all(idles) and max(maxes) < 2 * self.m:
-                    # Global quiescence: no pool anywhere can donate and
-                    # every host is idle — stop everywhere in the same
-                    # round (leftovers go to the host drain).
-                    stop_event.set()
-                    return
+                    # Global quiescence candidate: every host idle, no pool
+                    # can donate. Confirm with a second consecutive round
+                    # (sizes re-sampled after observing all-idle) so a
+                    # worker that was momentarily between polls can't have
+                    # its poppable work diverted to the serial host drain.
+                    quiescent_streak += 1
+                    if quiescent_streak >= 2:
+                        stop_event.set()
+                        return
+                    backoff = 1  # confirm promptly
+                    continue
+                quiescent_streak = 0
+                if not needy:
+                    # Everyone is busy and rich: back off geometrically so
+                    # a balanced run pays ~no collective overhead; any
+                    # needy report resets the cadence.
+                    backoff = min(backoff * 2, self.BACKOFF_MAX)
+                else:
+                    backoff = 1
                 continue
-            payload = None
-            receiver = -1
-            for d, r in pairs:
-                if d == me:
-                    payload = self._donate_from(pools)
-                    receiver = r
-            self._inflight = payload
-            blocks = coll.allgather_obj((receiver, payload))
-            self._inflight = None
-            if payload is not None:
-                self.blocks_sent += 1
-                self.nodes_sent += batch_length(payload)
-            for rcv, batch in blocks:
-                if rcv == me and batch is not None:
+            quiescent_streak = 0
+            backoff = 1
+            # Point-to-point delivery through the KV channel: only matched
+            # hosts touch payloads; keys are round-unique (the round counter
+            # advances in lockstep — one metadata allgather per round).
+            send_to = next((r for d, r in pairs if d == me), None)
+            recv_from = next((d for d, r in pairs if r == me), None)
+            if send_to is not None:
+                payload = self._donate_from(pools)
+                self._inflight = payload
+                coll.kv_set(
+                    f"tts/steal/{self.rounds}/{me}->{send_to}",
+                    pickle.dumps(payload),
+                )
+                self._inflight = None
+                if payload is not None:
+                    self.blocks_sent += 1
+                    self.nodes_sent += batch_length(payload)
+            if recv_from is not None:
+                batch = pickle.loads(
+                    coll.kv_get(
+                        f"tts/steal/{self.rounds}/{recv_from}->{me}",
+                        self.KV_TIMEOUT_S,
+                    )
+                )
+                if batch is not None:
                     # Whole block into one local pool (keeps it >= m so the
                     # receiving worker can pop; intra-host stealing spreads
                     # it from there).
@@ -336,7 +449,7 @@ def _host_search(
     comm = None
     if steal and collectives.num_hosts > 1:
         comm = _HostComm(
-            collectives, m, perc=perc, interval_s=steal_interval_s
+            collectives, m, perc=perc, interval_s=steal_interval_s, M=M
         )
     local = host_pipeline(
         problem, m, M, D, devices,

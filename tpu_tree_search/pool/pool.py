@@ -134,17 +134,22 @@ class SoAPool:
             out[name][:k] = arr[start : start + k]
         return k
 
-    def pop_front_bulk_half(self, m: int, perc: float = 0.5) -> dict | None:
+    def pop_front_bulk_half(
+        self, m: int, perc: float = 0.5, cap: int | None = None
+    ) -> dict | None:
         """Steal a ``perc`` fraction of the pool from the *front* (oldest,
         shallowest subtrees) iff size >= 2m. perc=0.5 is the steal-half
         policy of `Pool_par.chpl:180-191`; other fractions mirror the CUDA
-        baseline's `--perc` knob (`Pool_ext.c:138-151`). Returns a batch or
-        None.
+        baseline's `--perc` knob (`Pool_ext.c:138-151`). ``cap`` bounds the
+        stolen block (inter-host donations cap at M so a huge pool never
+        ships an unbounded block over DCN). Returns a batch or None.
         """
         if self.size < 2 * m:
             return None
         k = max(1, int(self.size * perc))
         k = min(k, self.size)
+        if cap is not None:
+            k = min(k, cap)
         batch = {
             name: arr[self.front : self.front + k].copy()
             for name, arr in self.data.items()

@@ -47,11 +47,15 @@ class PFSPProblem(Problem):
                 raise ValueError("Error: unsupported Taillard's instance")
             p_times = taillard.processing_times(inst)
             self.initial_ub = taillard.best_ub(inst) if ub == 1 else INF_BOUND
+            self.inst = inst
         else:
             if ub != 0:
                 raise ValueError("custom instances have no table optimum; use ub=0")
             self.initial_ub = INF_BOUND
-        self.inst = inst
+            # Ad-hoc matrix: no named identity (a checkpoint meta carrying
+            # the constructor-default inst would let two different ad-hoc
+            # instances of the same shape impersonate each other).
+            self.inst = None
         self.lb = lb
         self.ub = ub
         self.jobs = int(p_times.shape[1])
