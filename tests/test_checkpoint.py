@@ -91,9 +91,10 @@ def test_checkpoint_refuses_different_ptimes(tmp_path):
 
 
 def test_checkpoint_accepts_v1_when_meta_matches(tmp_path):
-    """A v1 checkpoint (no ptimes_sha digest) must still resume when every
-    other meta field matches — v1 NQueens/named-instance metas are
-    unambiguous (ADVICE r3). A v1 meta that disagrees still refuses."""
+    """v1 NQueens checkpoints (meta = N/g, fully identifying) must resume;
+    every v1 PFSP file is refused — v1-era writers stamped the default inst
+    even for ad-hoc matrices, so a v1 meta claiming a named instance may
+    belong to a different p_times matrix entirely (code-review r4)."""
     import json
 
     import numpy as np
@@ -115,15 +116,6 @@ def test_checkpoint_accepts_v1_when_meta_matches(tmp_path):
                 **arrays,
             )
 
-    prob = PFSPProblem(inst=14)
-    path = str(tmp_path / "v1.ckpt")
-    save_as_v1(path, prob, prob.root())
-    loaded = ckpt.load(path, prob)
-    assert loaded.tree == 5 and loaded.sol == 1
-
-    with pytest.raises(ValueError, match="checkpoint is for"):
-        ckpt.load(path, PFSPProblem(inst=15))
-
     qpath = str(tmp_path / "v1q.ckpt")
     qprob = NQueensProblem(N=9)
     save_as_v1(qpath, qprob, qprob.root())
@@ -131,13 +123,19 @@ def test_checkpoint_accepts_v1_when_meta_matches(tmp_path):
     with pytest.raises(ValueError, match="checkpoint is for"):
         ckpt.load(qpath, NQueensProblem(N=10))
 
-    # Ad-hoc PFSP matrices have no v1-expressible identity (two different
-    # matrices of the same shape would be indistinguishable) — refuse.
+    # Every v1 PFSP checkpoint is refused — named instances included: the
+    # v1 meta cannot prove which matrix produced the frontier.
+    prob = PFSPProblem(inst=14)
+    path = str(tmp_path / "v1.ckpt")
+    save_as_v1(path, prob, prob.root())
+    with pytest.raises(ValueError, match="v1 PFSP"):
+        ckpt.load(path, prob)
+
     apath = str(tmp_path / "v1adhoc.ckpt")
     ptm = taillard.reduced_instance(14, jobs=6, machines=4)
     aprob = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
     save_as_v1(apath, aprob, aprob.root())
-    with pytest.raises(ValueError, match="ad-hoc"):
+    with pytest.raises(ValueError, match="v1 PFSP"):
         ckpt.load(apath, aprob)
 
 

@@ -120,9 +120,10 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
             "--perc only applies to the work-stealing tiers (multi, dist)"
         )
     if not 0.0 < args.perc <= 1.0:
+        # Semantics of the steal fraction: reference `Pool_ext.c:138-151`.
         parser.error(
             "--perc must be in (0, 1]: the fraction of the victim's front "
-            "taken per steal (`Pool_ext.c:138-151`)"
+            "taken per steal"
         )
     if (
         args.hosts is not None or args.no_steal or args.distributed

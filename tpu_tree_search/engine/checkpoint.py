@@ -122,18 +122,19 @@ def load(path: str, problem: Problem) -> Checkpoint:
         want = problem_meta(problem)
         got = dict(header["meta"])
         if header["version"] == 1:
-            # v1 predates the p_times digest; its remaining meta fields
-            # (problem/N/g or inst/lb/ub/jobs/machines) are unambiguous for
-            # NQueens and *named* Taillard instances — accept those with the
-            # digest treated as advisory. Ad-hoc PFSP matrices (inst=None)
-            # stay rejected: without the digest two different matrices of
-            # the same shape are indistinguishable.
-            if want["problem"] != "nqueens" and want.get("inst") is None:
+            # v1 predates the p_times digest, and v1-era writers stamped the
+            # constructor-default inst even for ad-hoc matrices — so a v1
+            # PFSP meta claiming inst=14 may belong to a different matrix
+            # entirely and its frontier would silently resume with wrong
+            # bounds. NQueens meta (N, g) fully determines the search, so v1
+            # NQueens checkpoints remain resumable; every v1 PFSP file is
+            # refused.
+            if got.get("problem") != "nqueens":
                 raise ValueError(
-                    "v1 checkpoint cannot identify an ad-hoc PFSP instance "
-                    "(no p_times digest); re-run from scratch"
+                    "v1 PFSP checkpoints cannot be trusted: the format "
+                    "predates the p_times digest and may impersonate a named "
+                    "Taillard instance; re-run from scratch"
                 )
-            want = {k: v for k, v in want.items() if k != "ptimes_sha"}
             got.pop("ptimes_sha", None)
         if got != want:
             raise ValueError(

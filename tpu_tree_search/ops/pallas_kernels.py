@@ -52,6 +52,31 @@ def _round_up(x: int, k: int) -> int:
     return (x + k - 1) // k * k
 
 
+def _vmem_limit_bytes() -> int | None:
+    """Scoped-VMEM ceiling for the PFSP kernels. The Mosaic default (16 MB)
+    rejects the lb-family kernels above tile 64 (the (T, n, n) one-hot and
+    the (n, T, m) scan scratch pad n/m up to the 128-lane tile); v5e has
+    128 MB of VMEM, so raising the scope to 96 MB is safe for a standalone
+    pallas_call and lets the batch tile grow to MXU-efficient sizes."""
+    mb = int(os.environ.get("TTS_PALLAS_VMEM_MB", "96"))
+    if mb < 0:
+        raise ValueError(f"TTS_PALLAS_VMEM_MB must be >= 0 (0 disables), got {mb}")
+    return mb * 2**20 if mb else None
+
+
+def _compiler_params():
+    return pltpu.CompilerParams(
+        dimension_semantics=("arbitrary",), vmem_limit_bytes=_vmem_limit_bytes()
+    )
+
+
+def _env_tile(name: str, default: int) -> int:
+    tile = int(os.environ.get(name, str(default)))
+    if tile < 1:
+        raise ValueError(f"{name} must be a positive batch-tile size, got {tile}")
+    return tile
+
+
 # ---------------------------------------------------------------------------
 # N-Queens safety labels
 # ---------------------------------------------------------------------------
@@ -92,6 +117,7 @@ def _nqueens_call(N: int, g: int, B: int, tile: int, interpret: bool):
             pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((tile, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )
 
@@ -183,10 +209,15 @@ def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int,
     ).astype(jnp.int32)
     remain = jnp.sum(ptg * unsched[:, :, None], axis=1)  # (T, m)
 
-    f = front[:, None, :]  # (T, 1, m)
-    child_front = [f[..., 0] + ptg[..., 0]]
+    # 2-D static lane slices only: the (T, 1, m) reshape-then-extract form
+    # (front[:, None, :][..., j]) sends Mosaic down a pathological relayout
+    # path — ~17x slower compiles per chain and an XLA `array.h` check crash
+    # in larger compositions (measured on v5e, jax 0.9).
+    child_front = [front[:, 0:1] + ptg[..., 0]]
     for j in range(1, m):
-        child_front.append(jnp.maximum(child_front[-1], f[..., j]) + ptg[..., j])
+        child_front.append(
+            jnp.maximum(child_front[-1], front[:, j:j + 1]) + ptg[..., j]
+        )
     return onehot, ptg, front, remain, child_front
 
 
@@ -207,14 +238,16 @@ def _lb1_kernel(
         prmu, limit1, ptm, heads_ref[:], scan_ref, n, m, bf16
     )
 
-    # Child k: machine bound chain, unrolled over m.
-    tails = tails_ref[:][0]  # (m,)
-    cremain = remain[:, None, :] - ptg  # (T, n, m)
-    tmp0 = child_front[0] + cremain[..., 0]
-    lb = tmp0 + tails[0]
+    # Child k: machine bound chain, unrolled over m. Per-machine remain as a
+    # 2-D slice (see the relayout note in _tile_parent_state).
+    tails = tails_ref[:]  # (1, m)
+    tmp0 = child_front[0] + (remain[:, 0:1] - ptg[..., 0])
+    lb = tmp0 + tails[0, 0]
     for i in range(1, m):
-        tmp1 = jnp.maximum(tmp0, child_front[i] + cremain[..., i])
-        lb = jnp.maximum(lb, tmp1 + tails[i])
+        tmp1 = jnp.maximum(
+            tmp0, child_front[i] + (remain[:, i:i + 1] - ptg[..., i])
+        )
+        lb = jnp.maximum(lb, tmp1 + tails[0, i])
         tmp0 = tmp1
     out_ref[:] = lb
 
@@ -240,17 +273,21 @@ def _lb1_family_call(kernel_fn, n: int, m: int, B: int, tile: int,
         ],
         out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )
 
 
 def _lb1_family_bounds(
     kernel_fn, prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool,
-    bf16: bool = False,
+    bf16: bool = False, tile_env: str = "TTS_TILE_LB1", tile_default: int = 64,
 ):
     B, n = prmu.shape
     m = ptm_t.shape[1]
-    tile = min(256, B)
+    # Per-kernel tile defaults are measured, not uniform: Mosaic compile time
+    # for the lb1 kernel grows superlinearly with the batch tile (64 -> ~16s,
+    # 128 -> >270s on v5e), while lb1_d compiles at 256 in ~50s.
+    tile = min(_env_tile(tile_env, tile_default), B)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
@@ -280,14 +317,13 @@ def _lb1_d_kernel(
     _, ptg, front, remain, _ = _tile_parent_state(
         prmu, limit1, ptm, heads_ref[:], scan_ref, n, m, bf16
     )
-    back = tails_ref[:][0]  # (m,)
-    f = front[:, None, :]  # (T, 1, m)
-    r = remain[:, None, :]
-    lb = f[..., 0] + r[..., 0] + back[0]  # (T, 1) broadcasts to (T, n)
-    tmp0 = f[..., 0] + ptg[..., 0]  # (T, n)
+    back = tails_ref[:]  # (1, m)
+    # 2-D slices throughout (see the relayout note in _tile_parent_state).
+    lb = front[:, 0:1] + remain[:, 0:1] + back[0, 0]  # (T, 1) -> (T, n)
+    tmp0 = front[:, 0:1] + ptg[..., 0]  # (T, n)
     for i in range(1, m):
-        tmp1 = jnp.maximum(tmp0, f[..., i])
-        lb = jnp.maximum(lb, tmp1 + r[..., i] + back[i])
+        tmp1 = jnp.maximum(tmp0, front[:, i:i + 1])
+        lb = jnp.maximum(lb, tmp1 + remain[:, i:i + 1] + back[0, i])
         tmp0 = tmp1 + ptg[..., i]
     out_ref[:] = jnp.broadcast_to(lb, (T, n))
 
@@ -299,7 +335,7 @@ def pfsp_lb1_d_bounds(
     """(B, n) int32 lb1_d child bounds; same contract as `_lb1_d_chunk`."""
     return _lb1_family_bounds(
         _lb1_d_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
-        bf16,
+        bf16, tile_env="TTS_TILE_LB1D", tile_default=256,
     )
 
 
@@ -409,6 +445,7 @@ def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool,
         ],
         out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )
 
@@ -421,7 +458,7 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
     B, n = prmu.shape
     m = tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
-    tile = min(128, B)
+    tile = min(_env_tile("TTS_TILE_LB2", 128), B)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
