@@ -179,3 +179,76 @@ def test_large_instance_checkpoint_resume(tmp_path):
         m=25, M=1024, K=2, max_steps=2, resume_from=path,
     )
     assert res.explored_tree > part.explored_tree
+
+
+def test_multi_tier_checkpoint_resume(tmp_path):
+    """Multi-device tier: periodic chunk-boundary checkpoints during a full
+    run, then a resume from the mid-run snapshot reaches exactly the
+    sequential goldens (N-Queens has no pruning, so tree/sol totals are
+    schedule-independent). Also proves the format is tier-agnostic: the
+    same file resumes on the resident (device) tier."""
+    import os
+
+    from tpu_tree_search.parallel.multidevice import multidevice_search
+
+    path = str(tmp_path / "multi.ckpt")
+    prob = NQueensProblem(N=10)
+    seq = sequential_search(prob)
+    full = multidevice_search(
+        prob, m=5, M=256, D=2, checkpoint_path=path,
+        checkpoint_interval_s=0.05,
+    )
+    assert (full.explored_tree, full.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    assert os.path.exists(path), "no checkpoint fired during the run"
+    saved = ckpt.load(path, NQueensProblem(N=10))
+    assert saved.tree <= seq.explored_tree
+
+    resumed = multidevice_search(
+        NQueensProblem(N=10), m=5, M=256, D=2, resume_from=path
+    )
+    assert (resumed.explored_tree, resumed.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+
+    # Cross-tier: the multi checkpoint resumes on the resident engine.
+    res_dev = resident_search(NQueensProblem(N=10), m=8, M=256, resume_from=path)
+    assert (res_dev.explored_tree, res_dev.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+
+
+def test_dist_tier_checkpoint_resume(tmp_path):
+    """Dist tier (2 virtual hosts): per-host files cut in the same
+    communicator round; resuming both hosts reaches the sequential
+    goldens."""
+    import os
+
+    from tpu_tree_search.parallel.dist import dist_search
+
+    path = str(tmp_path / "dist.ckpt")
+    prob = NQueensProblem(N=10)
+    seq = sequential_search(prob)
+    full = dist_search(
+        prob, m=5, M=256, D=1, num_hosts=2, steal_interval_s=0.005,
+        checkpoint_path=path, checkpoint_interval_s=0.02,
+    )
+    assert (full.explored_tree, full.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    assert os.path.exists(path + ".h0") and os.path.exists(path + ".h1"), (
+        "per-host checkpoints did not fire"
+    )
+    # A per-host file refuses to resume into a different host count (it
+    # would silently drop the other hosts' shares).
+    with pytest.raises(ValueError, match="per-host files"):
+        ckpt.load(path + ".h0", NQueensProblem(N=10))
+
+    resumed = dist_search(
+        NQueensProblem(N=10), m=5, M=256, D=1, num_hosts=2,
+        steal_interval_s=0.005, resume_from=path,
+    )
+    assert (resumed.explored_tree, resumed.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )

@@ -62,6 +62,12 @@ def test_pfsp_banner_reports_makespan(capsys):
     (["nqueens", "--tier", "multi", "--perc", "1.5"], "in (0, 1]"),
     (["nqueens", "--tier", "multi", "--perc", "0"], "in (0, 1]"),
     (["nqueens", "--tier", "multi", "--perc", "-0.25"], "in (0, 1]"),
+    (["nqueens", "--tier", "dist", "--coordinator", "localhost:1"],
+     "require --distributed"),
+    (["nqueens", "--tier", "dist", "--host-id", "0"], "require --distributed"),
+    (["nqueens", "--tier", "seq", "--steal-interval", "0.1"],
+     "only applies to --tier dist"),
+    (["nqueens", "--tier", "dist", "--steal-interval", "-1"], "must be > 0"),
 ])
 def test_flag_validation(argv, msg, capsys):
     with pytest.raises(SystemExit) as e:

@@ -107,6 +107,66 @@ def test_use_pallas_routes_per_device():
     assert pallas_kernels.use_pallas(cpus[0]) is False
 
 
+@pytest.mark.parametrize(
+    "lb,inst,jobs,machines",
+    [
+        ("lb1", 31, 50, 5),     # ta031 class
+        ("lb1", 61, 100, 5),    # ta061 class
+        ("lb1_d", 31, 50, 5),
+        ("lb2", 31, 50, 5),
+        ("lb2", 61, 100, 5),
+    ],
+)
+def test_large_instance_kernels_match_oracle(lb, inst, jobs, machines):
+    """Large Taillard sizes (50-100 jobs) must stay on the Pallas path:
+    _auto_tile shrinks the batch tile so the VMEM-resident pass still fits
+    (the reference covers these by rebuilding with bigger params,
+    `Taillard.chpl:29-52`). Full-size n with a small batch keeps interpret
+    mode tractable on CPU."""
+    rng = np.random.default_rng(5)
+    prob = PFSPProblem(inst=inst, lb=lb, ub=1)
+    assert prob.jobs == jobs and prob.machines == machines
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    B = 24
+    prmu = np.stack([rng.permutation(jobs).astype(np.int32) for _ in range(B)])
+    limit1 = rng.integers(-1, jobs - 1, B).astype(np.int32)
+    pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+    if lb == "lb1":
+        oracle = pfsp_device._lb1_chunk(pd, ld, t.ptm_t, t.min_heads, t.min_tails)
+        got = pallas_kernels.pfsp_lb1_bounds(
+            pd, ld, t.ptm_t, t.min_heads, t.min_tails, interpret=True
+        )
+    elif lb == "lb1_d":
+        oracle = pfsp_device._lb1_d_chunk(pd, ld, t.ptm_t, t.min_heads, t.min_tails)
+        got = pallas_kernels.pfsp_lb1_d_bounds(
+            pd, ld, t.ptm_t, t.min_heads, t.min_tails, interpret=True
+        )
+    else:
+        oracle = pfsp_device._lb2_chunk(
+            pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+            t.pairs, t.lags, t.johnson_schedules,
+        )
+        got = pallas_kernels.pfsp_lb2_bounds(pd, ld, t, interpret=True)
+    k = np.arange(jobs)[None, :]
+    open_ = k >= limit1[:, None] + 1
+    assert np.array_equal(np.asarray(oracle)[open_], np.asarray(got)[open_])
+
+
+def test_auto_tile_shrinks_for_large_instances():
+    """The VMEM model must shrink tiles monotonically with job count and
+    never go below the floor of 8."""
+    at = pallas_kernels._auto_tile
+    assert at(20, 10, 64) == 64          # ta014: default fits
+    assert at(500, 20, 64) >= 8          # ta111: must shrink but stay valid
+    assert at(500, 20, 64) < 64
+    sizes = [at(n, 20, 256) for n in (20, 50, 100, 200, 500)]
+    assert sizes == sorted(sizes, reverse=True)
+    # Non-power-of-two overrides stay sublane-aligned and above the floor.
+    for n in (20, 100, 500):
+        t = at(n, 20, 100)
+        assert t >= 8 and (t == 100 or t % 8 == 0)
+
+
 @pytest.mark.parametrize("bf16", [False, True])
 @pytest.mark.parametrize(
     "inst,jobs,machines",

@@ -91,6 +91,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "(coordinator/process env supplied by the launcher, "
                         "e.g. GKE/TPU-VM metadata — the -nl/MPI launcher "
                         "analogue)")
+    common.add_argument("--coordinator", type=str, default=None,
+                        help="with --distributed: host:port of the rank-0 "
+                        "coordination service (defaults to launcher env)")
+    common.add_argument("--num-hosts", type=int, default=None,
+                        help="with --distributed: process count in the "
+                        "slice (defaults to launcher env)")
+    common.add_argument("--host-id", type=int, default=None,
+                        help="with --distributed: this process's rank "
+                        "(defaults to launcher env, e.g. TPU_WORKER_ID)")
+    common.add_argument("--steal-interval", type=float, default=0.02,
+                        help="dist tier: communicator cadence floor in "
+                        "seconds (backs off geometrically while all hosts "
+                        "are busy)")
     common.add_argument("--profile", type=str, default=None,
                         help="write a jax profiler trace of the search to "
                         "this directory (view with TensorBoard/XProf)")
@@ -134,6 +147,17 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
     if args.distributed and args.hosts is not None:
         parser.error("--distributed (real pods) and --hosts (virtual "
                      "hosts) are mutually exclusive")
+    if (
+        args.coordinator is not None
+        or args.num_hosts is not None
+        or args.host_id is not None
+    ) and not args.distributed:
+        parser.error("--coordinator/--num-hosts/--host-id require "
+                     "--distributed")
+    if args.steal_interval != 0.02 and args.tier != "dist":
+        parser.error("--steal-interval only applies to --tier dist")
+    if args.steal_interval <= 0:
+        parser.error("--steal-interval must be > 0")
     if args.hosts is not None and args.hosts < 1:
         parser.error("--hosts must be >= 1")
     if args.mp != 1:
@@ -169,9 +193,15 @@ def run_tier(problem, args):
         or args.max_steps is not None
         or args.K is not None
     )
-    if args.tier not in ("device", "mesh") and wants_resident:
+    if args.tier == "seq" and wants_resident:
         raise NotImplementedError(
-            "--checkpoint/--resume/--max-steps/--K need the device or mesh tier"
+            "--checkpoint/--resume/--max-steps/--K need a device tier"
+        )
+    if args.tier in ("multi", "dist") and (
+        args.max_steps is not None or args.K is not None
+    ):
+        raise NotImplementedError(
+            "--max-steps/--K need the device or mesh tier"
         )
     if args.tier == "seq":
         from .engine import sequential_search
@@ -199,17 +229,25 @@ def run_tier(problem, args):
         return mesh_resident_search(
             problem, m=args.m, M=args.M, D=args.D, mp=args.mp, **ckpt_kw
         )
+    ckpt_pass = dict(
+        checkpoint_path=args.checkpoint,
+        checkpoint_interval_s=args.checkpoint_interval,
+        resume_from=args.resume,
+    )
     if args.tier == "multi":
         from .parallel.multidevice import multidevice_search
 
         return multidevice_search(
-            problem, m=args.m, M=args.M, D=args.D, perc=args.perc
+            problem, m=args.m, M=args.M, D=args.D, perc=args.perc,
+            **ckpt_pass,
         )
     from .parallel.dist import dist_search
 
     return dist_search(
         problem, m=args.m, M=args.M, D=args.D, perc=args.perc,
         num_hosts=args.hosts, steal=not args.no_steal,
+        steal_interval_s=args.steal_interval,
+        **ckpt_pass,
     )
 
 
@@ -347,13 +385,24 @@ def main(argv=None) -> int:
         # the launcher's environment (the -nl / mpirun analogue).
         import jax
 
+        # Explicit flags override the launcher env (useful for manual
+        # launches and the docs/POD_LAUNCH.md two-shell smoke test); None
+        # falls back to GKE/TPU-VM metadata discovery.
+        init_kw = {}
+        if args.coordinator is not None:
+            init_kw["coordinator_address"] = args.coordinator
+        if args.num_hosts is not None:
+            init_kw["num_processes"] = args.num_hosts
+        if args.host_id is not None:
+            init_kw["process_id"] = args.host_id
         try:
-            jax.distributed.initialize()
+            jax.distributed.initialize(**init_kw)
         except Exception as e:
             print(
                 f"Error: jax.distributed.initialize() failed: {e}\n"
                 "(--distributed needs the launcher to supply coordinator/"
-                "process environment)",
+                "process environment, or pass --coordinator/--num-hosts/"
+                "--host-id explicitly)",
                 file=sys.stderr,
             )
             return 2

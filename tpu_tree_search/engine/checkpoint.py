@@ -75,6 +75,8 @@ class Checkpoint:
     best: int
     tree: int
     sol: int
+    hosts: int = 1  # multi-host sets: total per-host files in this cut
+    cut_tag: int | None = None  # dist tier: communicator round of the cut
 
 
 def problem_meta(problem: Problem) -> dict:
@@ -95,7 +97,8 @@ def problem_meta(problem: Problem) -> dict:
     return meta
 
 
-def save(path: str, problem: Problem, batch: NodeBatch, best: int, tree: int, sol: int) -> None:
+def save(path: str, problem: Problem, batch: NodeBatch, best: int, tree: int,
+         sol: int, hosts: int = 1, cut_tag: int | None = None) -> None:
     header = {
         "version": FORMAT_VERSION,
         "meta": problem_meta(problem),
@@ -103,6 +106,8 @@ def save(path: str, problem: Problem, batch: NodeBatch, best: int, tree: int, so
         "tree": int(tree),
         "sol": int(sol),
         "fields": sorted(batch.keys()),
+        "hosts": int(hosts),
+        "cut_tag": cut_tag,
     }
     arrays = {f"field_{k}": v for k, v in batch.items()}
     tmp = f"{path}.tmp.{os.getpid()}"
@@ -114,7 +119,10 @@ def save(path: str, problem: Problem, batch: NodeBatch, best: int, tree: int, so
     os.replace(tmp, path)
 
 
-def load(path: str, problem: Problem) -> Checkpoint:
+def load(path: str, problem: Problem, expect_hosts: int = 1) -> Checkpoint:
+    """``expect_hosts``: the host count of the resuming run. A per-host file
+    from an H-host cut resumed into a different-H run would silently drop
+    (or double-explore) the other hosts' shares — refuse loudly instead."""
     with np.load(path) as data:
         header = json.loads(bytes(data["header"]).decode())
         if header["version"] not in (1, FORMAT_VERSION):
@@ -140,8 +148,16 @@ def load(path: str, problem: Problem) -> Checkpoint:
             raise ValueError(
                 f"checkpoint is for {header['meta']}, not {problem_meta(problem)}"
             )
+        hosts = int(header.get("hosts", 1))
+        if hosts != expect_hosts:
+            raise ValueError(
+                f"checkpoint is 1 of {hosts} per-host files; resuming with "
+                f"{expect_hosts} host(s) would lose or double-explore the "
+                "other shares (resume with the original host count)"
+            )
         batch = {k: data[f"field_{k}"] for k in header["fields"]}
     return Checkpoint(
         meta=header["meta"], batch=batch,
         best=header["best"], tree=header["tree"], sol=header["sol"],
+        hosts=hosts, cut_tag=header.get("cut_tag"),
     )

@@ -77,6 +77,47 @@ def _env_tile(name: str, default: int) -> int:
     return tile
 
 
+def _r8(x: int) -> int:
+    return _round_up(x, 8)
+
+
+def _r128(x: int) -> int:
+    return _round_up(x, 128)
+
+
+def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
+               tn2_copies: int = 3) -> int:
+    """Shrink the batch tile until the kernel's modeled VMEM footprint fits.
+
+    The reference rebuilds with bigger compile-time params for large
+    instances (`Taillard.chpl:29-52`); here the same kernel covers 20-500
+    jobs by trading batch-tile size for job count — the big matmuls keep
+    T*n rows, so MXU utilization survives small T at large n. The model
+    sums the dominant tiled buffers against half the scoped-VMEM budget,
+    halving the tile until it fits (floor 8). ``tn2_copies`` counts the
+    (T, n, n)-class f32 live values (one-hot + reshape copies for lb1; the
+    pair loop's u_o/cum0/suf1 and their matmul copies push lb2 higher);
+    ``extra_bytes`` adds tile-independent residents (lb2's per-pair
+    tables)."""
+    budget = (_vmem_limit_bytes() or 16 * 2**20) // 2
+
+    def bytes_for(t: int) -> int:
+        tn2 = tn2_copies * t * _r8(n) * _r128(n) * 4
+        oh_nt = n * _r8(t) * _r128(n) * 4
+        scan = n * _r8(t) * _r128(m) * 4
+        ptg = t * _r8(n) * _r128(m) * 4
+        chains = 2 * m * t * _r128(n) * 4
+        return tn2 + oh_nt + scan + ptg + chains + extra_bytes
+
+    tile = default
+    while tile > 8 and bytes_for(tile) > budget:
+        # Halve, then align down to the sublane quantum (a non-power-of-two
+        # env override must not walk below the floor or mis-align the
+        # (tile, n) BlockSpec).
+        tile = max(8, (tile // 2) // 8 * 8)
+    return tile
+
+
 # ---------------------------------------------------------------------------
 # N-Queens safety labels
 # ---------------------------------------------------------------------------
@@ -286,8 +327,9 @@ def _lb1_family_bounds(
     m = ptm_t.shape[1]
     # Per-kernel tile defaults are measured, not uniform: Mosaic compile time
     # for the lb1 kernel grows superlinearly with the batch tile (64 -> ~16s,
-    # 128 -> >270s on v5e), while lb1_d compiles at 256 in ~50s.
-    tile = min(_env_tile(tile_env, tile_default), B)
+    # 128 -> >270s on v5e), while lb1_d compiles at 256 in ~50s. Large
+    # instances then shrink the tile further until the VMEM model fits.
+    tile = min(_auto_tile(n, m, _env_tile(tile_env, tile_default)), B)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
@@ -458,7 +500,14 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
     B, n = prmu.shape
     m = tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
-    tile = min(_env_tile("TTS_TILE_LB2", 128), B)
+    # Tile-independent residents: the (P, n, n) slot-order one-hots and the
+    # per-pair job/machine tables; the pair loop itself holds ~8
+    # (T, n, n)-class live f32 values (u_child, u_o, cum0, suf1, their
+    # matmul reshape copies) -> tn2_copies=8.
+    static_extra = (P * _r8(n) * _r128(n) + 3 * P * _r128(n)
+                    + 2 * P * _r128(m)) * 4
+    tile = min(_auto_tile(n, m, _env_tile("TTS_TILE_LB2", 128),
+                          extra_bytes=static_extra, tn2_copies=8), B)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))

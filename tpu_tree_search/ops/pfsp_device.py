@@ -325,9 +325,9 @@ def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     `evaluate.cu:93-119`)."""
     from . import pallas_kernels as PK
 
-    # Same n-gate as gather_ptimes: the kernel's (tile, n, n) one-hot stays
-    # within VMEM only for small job counts; large instances use the oracle.
-    if PK.use_pallas(device) and prmu.shape[-1] <= 64:
+    # The kernel covers every Taillard size (20-500 jobs): _auto_tile shrinks
+    # the batch tile as n grows so the VMEM-resident pass always fits.
+    if PK.use_pallas(device) and prmu.shape[-1] <= 512:
         return PK.pfsp_lb1_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
             bf16=tables.exact_bf16,
@@ -341,7 +341,7 @@ def lb1_d_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     (`evaluate.cu:51-71` is the per-parent CUDA counterpart)."""
     from . import pallas_kernels as PK
 
-    if PK.use_pallas(device) and prmu.shape[-1] <= 64:
+    if PK.use_pallas(device) and prmu.shape[-1] <= 512:
         return PK.pfsp_lb1_d_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
             bf16=tables.exact_bf16,
@@ -358,7 +358,9 @@ def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     intermediates round-trip HBM, which dominates its cost."""
     from . import pallas_kernels as PK
 
-    if PK.use_pallas(device) and prmu.shape[-1] <= 32:
+    # lb2's (P, n, n) slot-order tables cap the kernel at ~100 jobs
+    # (ta031-ta090); beyond that the jnp path has the same asymptotic cost.
+    if PK.use_pallas(device) and prmu.shape[-1] <= 100:
         return PK.pfsp_lb2_bounds(prmu, limit1, tables)
     return _lb2_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,

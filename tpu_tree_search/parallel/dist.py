@@ -263,7 +263,8 @@ class _HostComm:
     BACKOFF_MAX = 16  # cadence back-off cap (x interval_s)
 
     def __init__(self, collectives, m: int, perc: float = 0.5,
-                 interval_s: float = 0.02, M: int = 50000):
+                 interval_s: float = 0.02, M: int = 50000,
+                 ckpt_interval_s: float = 60.0):
         self.coll = collectives
         # Captured here (construction happens on the bound host thread):
         # ThreadCollectives.host_id is thread-local and the communicator
@@ -280,6 +281,14 @@ class _HostComm:
         self.nodes_received = 0
         self.error: BaseException | None = None
         self._inflight = None  # popped-but-undelivered donation block
+        # Checkpointing (set by run_workers when --checkpoint is active):
+        # host 0's clock decides WHEN; the decision rides the round's
+        # control tuple so every host snapshots in the same lockstep round
+        # — donations complete within a round, so no node can straddle the
+        # cut and the union of the per-host files is the exact frontier.
+        self.ckpt_mgr = None
+        self.ckpt_interval_s = ckpt_interval_s
+        self._ckpt_last = None
 
     def _donate_from(self, pools):
         """Locked front-steal from the fullest local pool (on behalf of a
@@ -352,12 +361,25 @@ class _HostComm:
             max_pool = max(p.size for p in pools)
             idle = states._all_idle()
             best = shared.read()
-            rows = coll.allgather_obj((size, max_pool, best, bool(idle)))
+            # Host 0's wall clock decides checkpoint rounds (host clocks
+            # need not agree; the flag in the control tuple synchronizes
+            # the cut).
+            want_ckpt = False
+            if self.ckpt_mgr is not None and me == 0:
+                if self._ckpt_last is None:
+                    self._ckpt_last = _time.monotonic()
+                elif (_time.monotonic() - self._ckpt_last
+                      >= self.ckpt_interval_s):
+                    want_ckpt = True
+            rows = coll.allgather_obj(
+                (size, max_pool, best, bool(idle), want_ckpt)
+            )
             gbest = min(r[2] for r in rows)
             shared.publish(gbest)
             sizes = [r[0] for r in rows]
             maxes = [r[1] for r in rows]
             idles = [r[3] for r in rows]
+            do_ckpt = self.ckpt_mgr is not None and rows[0][4]
             # Deterministic donor->receiver matching (identical on every
             # host): richest donors paired with hungriest idle receivers.
             donors = sorted(
@@ -381,49 +403,70 @@ class _HostComm:
                         stop_event.set()
                         return
                     backoff = 1  # confirm promptly
-                    continue
-                quiescent_streak = 0
-                if not needy:
-                    # Everyone is busy and rich: back off geometrically so
-                    # a balanced run pays ~no collective overhead; any
-                    # needy report resets the cadence.
-                    backoff = min(backoff * 2, self.BACKOFF_MAX)
                 else:
-                    backoff = 1
-                continue
-            quiescent_streak = 0
-            backoff = 1
-            # Point-to-point delivery through the KV channel: only matched
-            # hosts touch payloads; keys are round-unique (the round counter
-            # advances in lockstep — one metadata allgather per round).
-            send_to = next((r for d, r in pairs if d == me), None)
-            recv_from = next((d for d, r in pairs if r == me), None)
-            if send_to is not None:
-                payload = self._donate_from(pools)
-                self._inflight = payload
-                coll.kv_set(
-                    f"tts/steal/{self.rounds}/{me}->{send_to}",
-                    pickle.dumps(payload),
-                )
-                self._inflight = None
-                if payload is not None:
-                    self.blocks_sent += 1
-                    self.nodes_sent += batch_length(payload)
-            if recv_from is not None:
-                batch = pickle.loads(
-                    coll.kv_get(
-                        f"tts/steal/{self.rounds}/{recv_from}->{me}",
-                        self.KV_TIMEOUT_S,
+                    quiescent_streak = 0
+                    if not needy:
+                        # Everyone is busy and rich: back off geometrically
+                        # so a balanced run pays ~no collective overhead;
+                        # any needy report resets the cadence.
+                        backoff = min(backoff * 2, self.BACKOFF_MAX)
+                    else:
+                        backoff = 1
+            else:
+                quiescent_streak = 0
+                backoff = 1
+                # Point-to-point delivery through the KV channel: only
+                # matched hosts touch payloads; keys are round-unique (the
+                # round counter advances in lockstep — one metadata
+                # allgather per round).
+                send_to = next((r for d, r in pairs if d == me), None)
+                recv_from = next((d for d, r in pairs if r == me), None)
+                if send_to is not None:
+                    payload = self._donate_from(pools)
+                    self._inflight = payload
+                    coll.kv_set(
+                        f"tts/steal/{self.rounds}/{me}->{send_to}",
+                        pickle.dumps(payload),
                     )
+                    self._inflight = None
+                    if payload is not None:
+                        self.blocks_sent += 1
+                        self.nodes_sent += batch_length(payload)
+                if recv_from is not None:
+                    batch = pickle.loads(
+                        coll.kv_get(
+                            f"tts/steal/{self.rounds}/{recv_from}->{me}",
+                            self.KV_TIMEOUT_S,
+                        )
+                    )
+                    if batch is not None:
+                        # Whole block into one local pool (keeps it >= m so
+                        # the receiving worker can pop; intra-host stealing
+                        # spreads it from there).
+                        pools[rrobin].locked_push_back_bulk(batch)
+                        rrobin = (rrobin + 1) % len(pools)
+                        self.blocks_received += 1
+                        self.nodes_received += batch_length(batch)
+            if do_ckpt:
+                # Same round on every host (rows[0][4]): donations above
+                # completed, workers pause at chunk boundaries, each host
+                # stages its own share, and the set commits atomically only
+                # if EVERY host staged successfully — a host whose worker
+                # died keeps the whole set on the previous coherent cut
+                # (donated nodes must never appear in files from different
+                # rounds: they would be double-explored or lost on resume).
+                import os as _os
+
+                staging = self.ckpt_mgr.path + ".staging"
+                ok = self.ckpt_mgr.do_checkpoint(
+                    to_path=staging, cut_tag=self.rounds
                 )
-                if batch is not None:
-                    # Whole block into one local pool (keeps it >= m so the
-                    # receiving worker can pop; intra-host stealing spreads
-                    # it from there).
-                    pools[rrobin].locked_push_back_bulk(batch)
-                    rrobin = (rrobin + 1) % len(pools)
-                    self.blocks_received += 1
-                    self.nodes_received += batch_length(batch)
+                oks = coll.allgather_obj(bool(ok))
+                if all(oks):
+                    _os.replace(staging, self.ckpt_mgr.path)
+                elif _os.path.exists(staging):
+                    _os.remove(staging)
+                self._ckpt_last = _time.monotonic()
 
 
 def _host_search(
@@ -440,16 +483,23 @@ def _host_search(
     steal_interval_s: float = 0.02,
     perc: float = 0.5,
     partition_fn=None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 60.0,
+    resume_from: str | None = None,
 ):
     """One host's full pipeline (warm-up + stride slice, local multi-device
     runtime with an inter-host communicator, local drain); returns its local
     stats for reduction. Delegates to the shared ``host_pipeline``
     (SURVEY.md §1: the reference duplicates this scaffolding between its
-    multi and dist mains — we don't)."""
+    multi and dist mains — we don't). Checkpoints are per-host files
+    (``path.h<rank>``), cut in the same communicator round on every host —
+    or on independent timers when ``steal=False`` (no inter-host traffic
+    exists to straddle an unsynchronized cut)."""
     comm = None
     if steal and collectives.num_hosts > 1:
         comm = _HostComm(
-            collectives, m, perc=perc, interval_s=steal_interval_s, M=M
+            collectives, m, perc=perc, interval_s=steal_interval_s, M=M,
+            ckpt_interval_s=checkpoint_interval_s,
         )
     local = host_pipeline(
         problem, m, M, D, devices,
@@ -457,6 +507,9 @@ def _host_search(
         num_hosts=collectives.num_hosts, host_id=collectives.host_id,
         seed=seed_base + collectives.host_id, perc=perc, comm=comm,
         partition_fn=partition_fn,
+        checkpoint_path=checkpoint_path,
+        checkpoint_interval_s=checkpoint_interval_s,
+        resume_from=resume_from,
     )
     if comm is not None:
         local["comm"] = {
@@ -508,6 +561,9 @@ def dist_search(
     steal_interval_s: float = 0.02,
     perc: float = 0.5,
     partition_fn=None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 60.0,
+    resume_from: str | None = None,
 ) -> SearchResult:
     """Distributed search entry point.
 
@@ -533,6 +589,9 @@ def dist_search(
             problem, m, M, D, local_devices, coll, initial_best, share_bound,
             steal=steal, steal_interval_s=steal_interval_s, perc=perc,
             partition_fn=partition_fn,
+            checkpoint_path=checkpoint_path,
+            checkpoint_interval_s=checkpoint_interval_s,
+            resume_from=resume_from,
         )
         return _reduce(local, coll)
 
@@ -545,6 +604,9 @@ def dist_search(
         local = _host_search(
             problem, m, M, D, all_devices, coll, initial_best, share_bound,
             steal=False,
+            checkpoint_path=checkpoint_path,
+            checkpoint_interval_s=checkpoint_interval_s,
+            resume_from=resume_from,
         )
         return _reduce(local, coll)
 
@@ -570,6 +632,9 @@ def dist_search(
                 initial_best, share_bound,
                 steal=steal, steal_interval_s=steal_interval_s, perc=perc,
                 partition_fn=partition_fn,
+                checkpoint_path=checkpoint_path,
+                checkpoint_interval_s=checkpoint_interval_s,
+                resume_from=resume_from,
             )
             results[h] = _reduce(locals_[h], coll)
         except BaseException as e:  # propagate after join

@@ -56,6 +56,131 @@ class _SharedBest:
         return self._value
 
 
+class PauseGate:
+    """Chunk-boundary rendezvous for checkpointing the threaded tiers.
+
+    A worker at the top of its loop holds no in-flight nodes (the popped
+    chunk's children were pushed before it came back around), so pausing
+    every live worker there yields pools whose union is the exact frontier.
+    Workers call ``poll()`` once per iteration (no-op unless a pause is
+    wanted) and ``leave()`` on exit; the coordinator brackets the snapshot
+    with ``pause()``/``resume()``. The reference has no checkpointing at
+    all (SURVEY.md §5) — this is the thread-tier analogue of the resident
+    engine's between-cycles snapshot."""
+
+    def __init__(self, n_workers: int):
+        self._cond = threading.Condition()
+        self.active = n_workers
+        self.paused = 0
+        self.want = False
+
+    def poll(self) -> None:
+        with self._cond:
+            if not self.want:
+                return
+            self.paused += 1
+            self._cond.notify_all()
+            while self.want:
+                self._cond.wait()
+            self.paused -= 1
+            self._cond.notify_all()
+
+    def leave(self) -> None:
+        with self._cond:
+            self.active -= 1
+            self._cond.notify_all()
+
+    def pause(self) -> None:
+        with self._cond:
+            self.want = True
+            while self.paused < self.active:
+                self._cond.wait()
+
+    def resume(self) -> None:
+        with self._cond:
+            self.want = False
+            self._cond.notify_all()
+
+
+class CheckpointManager:
+    """Snapshot-and-save for the multi/dist tiers: pause workers at chunk
+    boundaries, merge every local pool's frontier into one batch, and write
+    the same tier-agnostic ``Checkpoint`` format the resident tiers use
+    (a multi checkpoint resumes on the device tier and vice versa; the
+    stride partition re-splits any frontier). ``base_tree``/``base_sol``
+    carry counts from phases outside the workers (warm-up, a resumed run's
+    history)."""
+
+    def __init__(self, problem: Problem, path: str, gate: PauseGate,
+                 pools, workers, shared, base_tree: int, base_sol: int,
+                 interval_s: float = 60.0, hosts: int = 1):
+        self.problem = problem
+        self.path = path
+        self.gate = gate
+        self.pools = pools
+        self.workers = workers
+        self.shared = shared
+        self.base_tree = base_tree
+        self.base_sol = base_sol
+        self.interval_s = interval_s
+        self.hosts = hosts
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def do_checkpoint(self, to_path: str | None = None,
+                      cut_tag: int | None = None) -> bool:
+        """Pause, snapshot, save; returns False (writing nothing) if a
+        worker has died — its popped chunk is gone from the pools, so a cut
+        would silently lose a subtree. ``to_path`` lets the dist tier stage
+        to a temp file for its collective two-phase commit."""
+        from ..engine import checkpoint as ckpt
+
+        self.gate.pause()
+        try:
+            # Re-check AFTER the rendezvous: a worker that crashed while
+            # pause() was gathering stragglers has left the gate (its error
+            # set) without pushing its chunk's children.
+            if any(w.error is not None for w in self.workers):
+                return False
+            merged = {k: [] for k in self.problem.empty_batch(0)}
+            for p in self.pools:
+                b = p.as_batch()
+                for k in merged:
+                    merged[k].append(b[k])
+            batch = {k: np.concatenate(v) for k, v in merged.items()}
+            tree = self.base_tree + sum(w.tree for w in self.workers)
+            sol = self.base_sol + sum(w.sol for w in self.workers)
+            best = min(
+                [self.shared.read() if self.shared is not None else INF_BOUND]
+                + [w.best for w in self.workers]
+            )
+            ckpt.save(to_path or self.path, self.problem, batch, best, tree,
+                      sol, hosts=self.hosts, cut_tag=cut_tag)
+            return True
+        finally:
+            self.gate.resume()
+
+    # -- timer mode (multi tier; the dist tier drives do_checkpoint from
+    # its communicator round instead, so all hosts cut in lockstep) --------
+    def _timer_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            with self.gate._cond:
+                if self.gate.active == 0:
+                    return
+            self.do_checkpoint()
+
+    def start_timer(self) -> None:
+        self._thread = threading.Thread(
+            target=self._timer_loop, name="tts-ckpt", daemon=True
+        )
+        self._thread.start()
+
+    def stop_timer(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join()
+
+
 class _Worker:
     def __init__(self, wid: int, problem: Problem, pool: ParallelSoAPool, device):
         self.wid = wid
@@ -92,6 +217,7 @@ def _worker_loop(
     rng: np.random.Generator,
     perc: float = 0.5,
     stop_event: threading.Event | None = None,
+    gate: PauseGate | None = None,
 ):
     problem = w.problem
     try:
@@ -100,6 +226,10 @@ def _worker_loop(
         D = len(pools)
         chunk_buf = problem.empty_batch(M)
         while True:
+            if gate is not None:
+                # Chunk boundary: nothing in flight — the checkpoint
+                # rendezvous point.
+                gate.poll()
             # Pre-mark BUSY: with an external idle sampler (the dist tier's
             # communicator thread) marking busy only *after* the pop would
             # open a window where a worker holds a chunk while looking idle.
@@ -164,6 +294,9 @@ def _worker_loop(
         w.error = e
         states.set_idle(w.wid)
         states.flag.set()  # unblock everyone; search aborts
+    finally:
+        if gate is not None:
+            gate.leave()
 
 
 def run_workers(
@@ -178,6 +311,10 @@ def run_workers(
     seed: int = 0xB0B,
     perc: float = 0.5,
     comm=None,
+    ckpt_path: str | None = None,
+    ckpt_interval_s: float = 60.0,
+    ckpt_base: tuple[int, int] = (0, 0),
+    ckpt_hosts: int = 1,
 ):
     """Step 2 of the multi-device tier: partition ``pool`` across D worker
     threads, run the offload/steal/terminate loops, join, and merge leftovers
@@ -200,12 +337,27 @@ def run_workers(
     for w in workers:
         w.best = best
     stop_event = threading.Event() if comm is not None else None
+    gate = mgr = None
+    if ckpt_path is not None:
+        gate = PauseGate(D)
+        mgr = CheckpointManager(
+            problem, ckpt_path, gate, pools, workers, shared,
+            base_tree=ckpt_base[0], base_sol=ckpt_base[1],
+            interval_s=ckpt_interval_s, hosts=ckpt_hosts,
+        )
+        if comm is not None:
+            # Dist tier: the communicator drives checkpoints from its
+            # exchange round so every host cuts in the same lockstep round
+            # (no donation can straddle the snapshot).
+            comm.ckpt_mgr = mgr
+        else:
+            mgr.start_timer()
     seeds = np.random.SeedSequence(seed)
     threads = [
         threading.Thread(
             target=_worker_loop,
             args=(w, pools, states, m, M, shared, np.random.default_rng(s),
-                  perc, stop_event),
+                  perc, stop_event, gate),
             name=f"tts-worker-{w.wid}",
         )
         for w, s in zip(workers, seeds.spawn(D))
@@ -223,6 +375,8 @@ def run_workers(
         t.join()
     if comm_thread is not None:
         comm_thread.join()
+    if mgr is not None and comm is None:
+        mgr.stop_timer()
     for w in workers:
         if w.error is not None:
             raise w.error
@@ -251,6 +405,9 @@ def host_pipeline(
     perc: float = 0.5,
     comm=None,
     partition_fn=None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 60.0,
+    resume_from: str | None = None,
 ) -> dict:
     """The full 3-phase pipeline one host runs: warm-up, partitioned
     parallel offload (work stealing + termination), drain.
@@ -272,34 +429,54 @@ def host_pipeline(
         if initial_best is not None
         else getattr(problem, "initial_ub", INF_BOUND)
     )
+    # Per-host files for the multi-host tiers (each host snapshots its own
+    # pools; resume needs the same host count).
+    suffix = f".h{host_id}" if num_hosts > 1 else ""
+    eff_ckpt = None if checkpoint_path is None else checkpoint_path + suffix
+    eff_resume = None if resume_from is None else resume_from + suffix
+
     pool = SoAPool(problem.node_fields())
-    pool.push_back(index_batch(problem.root(), 0))
-
     t0 = time.perf_counter()
+    if eff_resume is not None:
+        # Resume replaces warm-up entirely: the loaded frontier IS this
+        # host's share (same tier-agnostic format as the resident tiers).
+        from ..engine import checkpoint as ckpt_mod
 
-    # -- step 1: warm-up to H*D*m (`nqueens_multigpu_chpl.chpl:173`,
-    # dist target `pfsp_dist_multigpu_chpl.chpl:339-345`) ------------------
-    tree1, sol1, best = warmup(problem, pool, best, num_hosts * D * m)
-    if num_hosts > 1:
-        warm = pool.as_batch()
-        pool = SoAPool(problem.node_fields())
-        if partition_fn is None:
-            pool.push_back_bulk(
-                {k: v[host_id::num_hosts] for k, v in warm.items()}
-            )
-        else:
-            # Test/experiment hook: arbitrary (possibly skewed) host
-            # partitions, e.g. to exercise inter-host stealing from a host
-            # that starts empty.
-            pool.push_back_bulk(partition_fn(warm, host_id, num_hosts))
-        if host_id != 0:
-            tree1 = sol1 = 0
+        loaded = ckpt_mod.load(eff_resume, problem, expect_hosts=num_hosts)
+        pool.push_back_bulk(loaded.batch)
+        tree1, sol1 = 0, 0
+        base_tree, base_sol = loaded.tree, loaded.sol
+        best = min(best, loaded.best)
+    else:
+        base_tree = base_sol = 0
+        pool.push_back(index_batch(problem.root(), 0))
+
+        # -- step 1: warm-up to H*D*m (`nqueens_multigpu_chpl.chpl:173`,
+        # dist target `pfsp_dist_multigpu_chpl.chpl:339-345`) --------------
+        tree1, sol1, best = warmup(problem, pool, best, num_hosts * D * m)
+        if num_hosts > 1:
+            warm = pool.as_batch()
+            pool = SoAPool(problem.node_fields())
+            if partition_fn is None:
+                pool.push_back_bulk(
+                    {k: v[host_id::num_hosts] for k, v in warm.items()}
+                )
+            else:
+                # Test/experiment hook: arbitrary (possibly skewed) host
+                # partitions, e.g. to exercise inter-host stealing from a
+                # host that starts empty.
+                pool.push_back_bulk(partition_fn(warm, host_id, num_hosts))
+            if host_id != 0:
+                tree1 = sol1 = 0
     t1 = time.perf_counter()
 
     # -- step 2: partitioned parallel offload ------------------------------
     pool, tree2, sol2, best, workers = run_workers(
         problem, pool, D, assigned, m, M, best, share_bound, seed=seed,
         perc=perc, comm=comm,
+        ckpt_path=eff_ckpt, ckpt_interval_s=checkpoint_interval_s,
+        ckpt_base=(base_tree + tree1, base_sol + sol1),
+        ckpt_hosts=num_hosts,
     )
     t2 = time.perf_counter()
 
@@ -313,8 +490,8 @@ def host_pipeline(
         device_to_host=sum(w.diagnostics.device_to_host for w in workers),
     )
     return {
-        "tree": tree1 + tree2 + tree3,
-        "sol": sol1 + sol2 + sol3,
+        "tree": base_tree + tree1 + tree2 + tree3,
+        "sol": base_sol + sol1 + sol2 + sol3,
         "best": best,
         "steals": sum(w.steals for w in workers),
         "phases": [
@@ -337,6 +514,9 @@ def multidevice_search(
     initial_best: int | None = None,
     share_bound: bool = True,
     perc: float = 0.5,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 60.0,
+    resume_from: str | None = None,
 ) -> SearchResult:
     import jax
 
@@ -345,7 +525,10 @@ def multidevice_search(
     if D is None:
         D = len(devices)
     local = host_pipeline(
-        problem, m, M, D, devices, initial_best, share_bound, perc=perc
+        problem, m, M, D, devices, initial_best, share_bound, perc=perc,
+        checkpoint_path=checkpoint_path,
+        checkpoint_interval_s=checkpoint_interval_s,
+        resume_from=resume_from,
     )
     return SearchResult(
         explored_tree=local["tree"],
