@@ -258,15 +258,13 @@ def main() -> int:
     extras: list[dict] = []
     try:
         # -- headline: PFSP ta014 lb1 --------------------------------------
-        res, nps, elapsed, device_phase = run_config(
-            PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=65536
-        )
+        prob_hl = PFSPProblem(inst=14, lb="lb1", ub=1)
+        res, nps, elapsed, device_phase = run_config(prob_hl, m=25, M=65536)
         parity = (
             res.explored_tree == GOLDEN_LB1["tree"]
             and res.explored_sol == GOLDEN_LB1["sol"]
             and res.best == GOLDEN_LB1["makespan"]
         )
-        prob_hl = PFSPProblem(inst=14, lb="lb1", ub=1)
         record = {
             "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
             "value": round(nps, 1),

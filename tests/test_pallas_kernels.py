@@ -191,3 +191,102 @@ def test_lb1_d_bounds_match_oracle(inst, jobs, machines, bf16):
         interpret=True, bf16=bf16,
     )
     assert np.array_equal(np.asarray(oracle), np.asarray(got))
+
+
+def _random_nodes(rng, jobs, R, min_limit1=0):
+    prmu = np.stack([rng.permutation(jobs).astype(np.int32) for _ in range(R)])
+    limit1 = rng.integers(min_limit1, jobs - 1, R).astype(np.int32)
+    return prmu, limit1
+
+
+def test_lb2_self_chunk_matches_host_oracle():
+    """The vectorized self bound (a node's OWN Johnson bound — the staged
+    evaluator's second stage) must equal the NumPy host oracle
+    (`lb2_bound`, c_bound_johnson.c:239-254) node by node."""
+    from tpu_tree_search.problems.pfsp import bounds as B
+
+    rng = np.random.default_rng(17)
+    jobs = 8
+    ptm = taillard.reduced_instance(14, jobs=jobs, machines=5)
+    prob = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    prmu, limit1 = _random_nodes(rng, jobs, 64)
+    got = np.asarray(pfsp_device._lb2_self_chunk(
+        jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads,
+        t.min_tails, t.pairs, t.lags, t.johnson_schedules,
+    ))
+    for r in range(64):
+        want = B.lb2_bound(
+            prob.lb1_data, prob.lb2_data, prmu[r], int(limit1[r]), jobs, 10**9
+        )
+        assert got[r] == want, (r, got[r], want)
+
+
+def test_lb2_self_kernel_matches_chunk_with_gating():
+    """Pallas self kernel (interpret mode) vs the jnp self chunk on the
+    active prefix; rows beyond n_active live in skipped tiles and are
+    unconstrained."""
+    rng = np.random.default_rng(23)
+    prob = PFSPProblem(inst=14, lb="lb2", ub=1)
+    jobs = prob.jobs
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    R = 600  # not a tile multiple: exercises padding
+    prmu, limit1 = _random_nodes(rng, jobs, R)
+    oracle = np.asarray(pfsp_device._lb2_self_chunk(
+        jnp.asarray(prmu), jnp.asarray(limit1), t.ptm_t, t.min_heads,
+        t.min_tails, t.pairs, t.lags, t.johnson_schedules,
+    ))
+    for n_active in (R, 97):
+        got = np.asarray(pallas_kernels.pfsp_lb2_self_bounds(
+            jnp.asarray(prmu), jnp.asarray(limit1), n_active, t,
+            interpret=True,
+        ))
+        assert np.array_equal(got[:n_active], oracle[:n_active])
+
+
+def test_lb2_dominates_lb1_on_device_evaluators():
+    """The staging invariant: the device lb2 child bounds are >= the device
+    lb1 child bounds pointwise (every machine's lb1 term is the one-machine
+    term of some Johnson pair), so skipping lb2 where lb1 >= best is exact."""
+    rng = np.random.default_rng(29)
+    for inst, jobs in ((14, 20), (1, 12)):
+        if jobs == 20:
+            prob = PFSPProblem(inst=inst, lb="lb2", ub=1)
+        else:
+            ptm = taillard.reduced_instance(inst, jobs=jobs, machines=5)
+            prob = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+        t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+        prmu, limit1 = _random_nodes(rng, jobs, 128)
+        pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+        b1 = np.asarray(pfsp_device._lb1_chunk(
+            pd, ld, t.ptm_t, t.min_heads, t.min_tails
+        ))
+        b2 = np.asarray(pfsp_device._lb2_chunk(
+            pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+            t.pairs, t.lags, t.johnson_schedules,
+        ))
+        open_ = np.arange(jobs)[None, :] >= (limit1[:, None] + 1)
+        assert np.all(b2[open_] >= b1[open_])
+
+
+def test_lb2_staged_bounds_match_full_on_candidates():
+    """lb2_bounds_staged (compaction + self bound + scatter) equals the full
+    child evaluator everywhere the candidate mask is set."""
+    rng = np.random.default_rng(31)
+    prob = PFSPProblem(inst=14, lb="lb2", ub=1)
+    jobs = prob.jobs
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    B = 48
+    prmu, limit1 = _random_nodes(rng, jobs, B)
+    pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+    full = np.asarray(pfsp_device._lb2_chunk(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    ))
+    open_ = np.arange(jobs)[None, :] >= (limit1[:, None] + 1)
+    leaf = open_ & ((limit1[:, None] + 2) == jobs)
+    cand = open_ & ~leaf & (rng.random((B, jobs)) < 0.4)
+    got = np.asarray(pfsp_device.lb2_bounds_staged(
+        pd, ld, jnp.asarray(cand), t
+    ))
+    assert np.array_equal(got[cand], full[cand])

@@ -101,3 +101,42 @@ def test_large_taillard_instances_run():
     )
     assert res.explored_tree > 0
 
+
+
+def test_lb2_staged_end_to_end_parity(monkeypatch):
+    """TTS_LB2_STAGED=1 forces the staged evaluator (lb1 prefilter ->
+    compacted self-lb2) on CPU; tree/sol/best must match the single-pass
+    lb2 run node-for-node — staging is an exact work reduction, not an
+    approximation. Fresh problem objects per mode (resident programs cache
+    on the instance and the knob is read at build time)."""
+    ptm = taillard.reduced_instance(14, jobs=10, machines=5)
+    opt = sequential_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm)).best
+
+    monkeypatch.setenv("TTS_LB2_STAGED", "0")
+    base = resident_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=256, K=8,
+        initial_best=opt,
+    )
+    monkeypatch.setenv("TTS_LB2_STAGED", "1")
+    staged = resident_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=256, K=8,
+        initial_best=opt,
+    )
+    assert (staged.explored_tree, staged.explored_sol, staged.best) == (
+        base.explored_tree, base.explored_sol, base.best
+    )
+
+    # Improving-incumbent mode too (best changes mid-run, so the candidate
+    # mask shifts cycle to cycle).
+    monkeypatch.setenv("TTS_LB2_STAGED", "0")
+    base2 = resident_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=256, K=8
+    )
+    monkeypatch.setenv("TTS_LB2_STAGED", "1")
+    staged2 = resident_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=256, K=8
+    )
+    assert (staged2.explored_tree, staged2.explored_sol, staged2.best) == (
+        base2.explored_tree, base2.explored_sol, base2.best
+    )
+    assert staged2.best == opt

@@ -100,10 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_argument("--host-id", type=int, default=None,
                         help="with --distributed: this process's rank "
                         "(defaults to launcher env, e.g. TPU_WORKER_ID)")
-    common.add_argument("--steal-interval", type=float, default=0.02,
+    common.add_argument("--steal-interval", type=float, default=None,
                         help="dist tier: communicator cadence floor in "
-                        "seconds (backs off geometrically while all hosts "
-                        "are busy)")
+                        "seconds (default 0.02; backs off geometrically "
+                        "while all hosts are busy)")
     common.add_argument("--profile", type=str, default=None,
                         help="write a jax profiler trace of the search to "
                         "this directory (view with TensorBoard/XProf)")
@@ -154,10 +154,11 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
     ) and not args.distributed:
         parser.error("--coordinator/--num-hosts/--host-id require "
                      "--distributed")
-    if args.steal_interval != 0.02 and args.tier != "dist":
-        parser.error("--steal-interval only applies to --tier dist")
-    if args.steal_interval <= 0:
-        parser.error("--steal-interval must be > 0")
+    if args.steal_interval is not None:
+        if args.tier != "dist":
+            parser.error("--steal-interval only applies to --tier dist")
+        if args.steal_interval <= 0:
+            parser.error("--steal-interval must be > 0")
     if args.hosts is not None and args.hosts < 1:
         parser.error("--hosts must be >= 1")
     if args.mp != 1:
@@ -246,7 +247,9 @@ def run_tier(problem, args):
     return dist_search(
         problem, m=args.m, M=args.M, D=args.D, perc=args.perc,
         num_hosts=args.hosts, steal=not args.no_steal,
-        steal_interval_s=args.steal_interval,
+        steal_interval_s=(
+            0.02 if args.steal_interval is None else args.steal_interval
+        ),
         **ckpt_pass,
     )
 

@@ -122,7 +122,8 @@ class _ResidentProgram:
     """
 
     def __init__(self, problem, m: int, M: int, K: int, capacity: int, device,
-                 mp_axis: str | None = None, mp_size: int = 1):
+                 mp_axis: str | None = None, mp_size: int = 1,
+                 allow_staged: bool = True):
         import jax
 
         self.problem = problem
@@ -133,6 +134,10 @@ class _ResidentProgram:
         # _make_eval); harmless None/1 everywhere else.
         self.mp_axis = mp_axis
         self.mp_size = mp_size
+        # Staged lb2 (lb1 prefilter + compacted self bound) — disabled by
+        # the mesh tier (the compaction runs inside shard_map; unvalidated
+        # there) and anywhere the evaluator must stay single-pass.
+        self.allow_staged = allow_staged
         n = problem.child_slots
         # Counter headroom: every step call accumulates at most K*M*n into
         # int32 counters.
@@ -346,7 +351,33 @@ class _PFSPResident(_ResidentProgram):
         mp_axis = self.mp_axis
         mp_size = self.mp_size
 
+        staged = (
+            lb == "lb2" and mp_axis is None and self.allow_staged
+            and P.lb2_staged_enabled(device, n)
+        )
+
         def evaluate(prmu_c, limit1_c, valid, best):
+            pdepth = limit1_c + 1
+            kk = jnp.arange(n, dtype=jnp.int32)[None, :]
+            open_ = (kk >= pdepth[:, None]) & valid[:, None]
+            leaf = open_ & ((pdepth[:, None] + 1) == n)
+            sol_inc = jnp.sum(leaf, dtype=jnp.int32)
+            if staged:
+                # Incumbent-aware staging: the cheap lb1 pass decides leaves
+                # and the candidate set; lb2 runs only on compacted
+                # candidates (exact: lb2 >= lb1 pointwise, so lb1-dead
+                # children are lb2-dead too). Leaf bounds under lb1 ARE the
+                # makespan (complete schedule), so the incumbent fold is
+                # identical to the single-pass path.
+                bounds1 = P.lb1_bounds(prmu_c, limit1_c, t, device)
+                best = jnp.minimum(
+                    best, jnp.min(jnp.where(leaf, bounds1, INF_BOUND))
+                )
+                cand = open_ & (~leaf) & (bounds1 < best)
+                bounds2 = P.lb2_bounds_staged(prmu_c, limit1_c, cand, t,
+                                              device)
+                keep = cand & (bounds2 < best)
+                return keep, sol_inc, best
             if lb == "lb1":
                 bounds = P.lb1_bounds(prmu_c, limit1_c, t, device)
             elif lb == "lb1_d":
@@ -357,11 +388,6 @@ class _PFSPResident(_ResidentProgram):
                 )
             else:
                 bounds = P.lb2_bounds(prmu_c, limit1_c, t, device)
-            pdepth = limit1_c + 1
-            kk = jnp.arange(n, dtype=jnp.int32)[None, :]
-            open_ = (kk >= pdepth[:, None]) & valid[:, None]
-            leaf = open_ & ((pdepth[:, None] + 1) == n)
-            sol_inc = jnp.sum(leaf, dtype=jnp.int32)
             # Leaf makespans fold into the incumbent before the prune test,
             # exactly like the host generate_children (`pfsp_chpl.chpl:100-111`).
             best = jnp.minimum(best, jnp.min(jnp.where(leaf, bounds, INF_BOUND)))
@@ -413,6 +439,7 @@ class _NQueensResident(_ResidentProgram):
 def _make_program(
     problem: Problem, m, M, K, capacity, device,
     mp_axis: str | None = None, mp_size: int = 1,
+    allow_staged: bool = True,
 ) -> _ResidentProgram:
     # One compiled program per (problem, config): rebuilding the jit closure
     # would recompile the whole while-loop program on every search (~30 s on
@@ -420,15 +447,17 @@ def _make_program(
     cache = getattr(problem, "_resident_programs", None)
     if cache is None:
         cache = problem._resident_programs = {}
-    key = (m, M, K, capacity, id(device), mp_axis, mp_size)
+    key = (m, M, K, capacity, id(device), mp_axis, mp_size, allow_staged)
     if key in cache:
         return cache[key]
     if isinstance(problem, PFSPProblem):
         prog = _PFSPResident(problem, m, M, K, capacity, device,
-                             mp_axis=mp_axis, mp_size=mp_size)
+                             mp_axis=mp_axis, mp_size=mp_size,
+                             allow_staged=allow_staged)
     elif isinstance(problem, NQueensProblem):
         prog = _NQueensResident(problem, m, M, K, capacity, device,
-                                mp_axis=mp_axis, mp_size=mp_size)
+                                mp_axis=mp_axis, mp_size=mp_size,
+                                allow_staged=allow_staged)
     else:
         raise TypeError(f"no resident program for {type(problem).__name__}")
     cache[key] = prog

@@ -118,6 +118,32 @@ def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
     return tile
 
 
+def _auto_tile_fits(n: int, m: int, default: int, extra_bytes: int = 0,
+                    tn2_copies: int = 3) -> bool:
+    """True iff the kernel fits the VMEM model even at the smallest tile —
+    the routing gate: shapes that do not fit must stay on the jnp path
+    instead of dying inside a Mosaic VMEM OOM."""
+    budget = (_vmem_limit_bytes() or 16 * 2**20) // 2
+    tile = _auto_tile(n, m, default, extra_bytes, tn2_copies)
+    tn2 = tn2_copies * tile * _r8(n) * _r128(n) * 4
+    oh_nt = n * _r8(tile) * _r128(n) * 4
+    scan = n * _r8(tile) * _r128(m) * 4
+    ptg = tile * _r8(n) * _r128(m) * 4
+    chains = 2 * m * tile * _r128(n) * 4
+    return tn2 + oh_nt + scan + ptg + chains + extra_bytes <= budget
+
+
+def lb1_kernel_feasible(n: int, m: int) -> bool:
+    return _auto_tile_fits(n, m, _env_tile("TTS_TILE_LB1", 64))
+
+
+def lb2_kernel_feasible(n: int, m: int, P: int) -> bool:
+    static_extra = (P * _r8(n) * _r128(n) + 3 * P * _r128(n)
+                    + 2 * P * _r128(m)) * 4
+    return _auto_tile_fits(n, m, _env_tile("TTS_TILE_LB2", 128),
+                           extra_bytes=static_extra, tn2_copies=8)
+
+
 # ---------------------------------------------------------------------------
 # N-Queens safety labels
 # ---------------------------------------------------------------------------
@@ -539,3 +565,162 @@ def pfsp_lb1_bounds(
         _lb1_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
         bf16,
     )
+
+
+# ---------------------------------------------------------------------------
+# PFSP lb2 self bound (staged evaluation)
+# ---------------------------------------------------------------------------
+
+
+def _lb2_self_kernel(
+    prmu_ref, limit1_ref, nact_ref, ptm_ref,
+    p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
+    out_ref, scan_ref, *, n: int, m: int, P: int, tile: int,
+    bf16: bool = False,
+):
+    """Johnson bound of each ROW's own partial schedule (the staged
+    evaluator's compacted child nodes) — `_lb2_kernel` with the
+    child-expansion axis dropped. Tiles whose rows are all beyond
+    ``n_active`` skip the entire body: this is where the incumbent-driven
+    work reduction lands (the reference's per-thread early exit,
+    `evaluate.cu:73-91`, becomes whole-tile skipping on the sequential
+    TPU grid)."""
+
+    @pl.when(pl.program_id(0) * tile < nact_ref[0])
+    def _active():
+        prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
+        limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,) — always >= 0
+        ptm = ptm_ref[:].astype(jnp.float32)  # (n, m)
+        T = prmu.shape[0]
+        hp = _hp_dot
+
+        # schedule_front via the position-major scan staging (see
+        # _tile_parent_state for why the scratch ref is required).
+        iota_nT = jax.lax.broadcasted_iota(jnp.int32, (n, T, n), 2)
+        oh_nT = (iota_nT == prmu.T[:, :, None]).astype(jnp.float32)
+        scan_ref[...] = (
+            hp(oh_nT.reshape(n * T, n), ptm, bf16)
+            .reshape(n, T, m).astype(jnp.int32)
+        )
+
+        def scan_step(i, front):
+            pt = scan_ref[i]
+            cols = [front[:, 0] + pt[:, 0]]
+            for j in range(1, m):
+                cols.append(jnp.maximum(cols[-1], front[:, j]) + pt[:, j])
+            newf = jnp.stack(cols, axis=-1)
+            return jnp.where((i <= limit1)[:, None], newf, front)
+
+        front = jax.lax.fori_loop(
+            0, n, scan_step, jnp.zeros((T, m), jnp.int32)
+        ).astype(jnp.float32)
+
+        # Free flags by job id.
+        jobs_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n, n), 2)
+        onehot = (jobs_iota == prmu[:, :, None]).astype(jnp.float32)
+        slot_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n), 1)
+        unsched = (slot_iota >= (limit1 + 1)[:, None]).astype(jnp.float32)
+        u = jnp.sum(onehot * unsched[:, :, None], axis=1)  # (T, job)
+
+        neg = jnp.float32(-(2.0**30))
+        ri = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        ci = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        tri_incl = (ri <= ci).astype(jnp.float32)
+        tri_suf = (ri >= ci).astype(jnp.float32)
+
+        def pair_body(q, lb):
+            jord = jorder_ref[q]  # (n, n)
+            u_o = hp(u, jord.T, bf16)  # (T, n) ordered free flags
+            p0 = p0_ref[q][0].astype(jnp.float32)  # (n,)
+            p1 = p1_ref[q][0].astype(jnp.float32)
+            lag = lag_ref[q][0].astype(jnp.float32)
+            s0 = msel0_ref[q][0].astype(jnp.float32)  # (m,)
+            s1 = msel1_ref[q][0].astype(jnp.float32)
+            tmp0_0 = jnp.sum(front * s0[None, :], axis=-1, keepdims=True)
+            tmp1_0 = jnp.sum(front * s1[None, :], axis=-1, keepdims=True)
+            mp0 = u_o * p0[None, :]
+            mp1 = u_o * p1[None, :]
+            cum0 = hp(mp0, tri_incl, bf16)
+            suf1 = hp(mp1, tri_suf, bf16)
+            a = jnp.where(u_o > 0, tmp0_0 + cum0 + lag[None, :] + suf1, neg)
+            tmp1 = jnp.maximum(
+                tmp1_0 + jnp.sum(mp1, axis=-1, keepdims=True),
+                jnp.max(a, axis=-1, keepdims=True),
+            )
+            tmp0 = tmp0_0 + jnp.sum(mp0, axis=-1, keepdims=True)
+            pair_lb = jnp.maximum(
+                tmp1 + t1_ref[q].astype(jnp.float32),
+                tmp0 + t0_ref[q].astype(jnp.float32),
+            )
+            return jnp.maximum(lb, pair_lb)
+
+        lb = jax.lax.fori_loop(0, P, pair_body, jnp.zeros((T, 1), jnp.float32))
+        out_ref[:] = lb.astype(jnp.int32)
+
+
+@lru_cache(maxsize=None)
+def _lb2_self_call(n: int, m: int, P: int, R: int, tile: int, interpret: bool,
+                   bf16: bool = False):
+    kernel = partial(_lb2_self_kernel, n=n, m=m, P=P, tile=tile, bf16=bf16)
+    grid = (R // tile,)
+    full = lambda i: (0, 0)
+    full3 = lambda i: (0, 0, 0)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((R, 1), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, n, n), full3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )
+
+
+def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
+                         interpret: bool = False, bf16: bool | None = None):
+    """(R,) int32 self lb2 bounds; rows >= n_active are garbage (their
+    tiles are skipped entirely). Same contract as `_lb2_self_chunk` on the
+    first n_active rows."""
+    if bf16 is None:
+        bf16 = getattr(tables, "exact_bf16", False)
+    R, n = prmu.shape
+    m = tables.ptm_t.shape[1]
+    P = tables.pairs.shape[0]
+    static_extra = (P * _r8(n) * _r128(n) + 3 * P * _r128(n)
+                    + 2 * P * _r128(m)) * 4
+    tile = min(_auto_tile(n, m, _env_tile("TTS_TILE_LB2SELF", 256),
+                          extra_bytes=static_extra, tn2_copies=6), R)
+    Rp = _round_up(R, tile)
+    if Rp != R:
+        prmu = jnp.pad(prmu, ((0, Rp - R), (0, 0)))
+        limit1 = jnp.pad(limit1, ((0, Rp - R),))
+    ordered = tables.johnson_ordered()
+    out = _lb2_self_call(n, m, P, Rp, tile, interpret, bf16)(
+        prmu.astype(jnp.int32),
+        limit1.astype(jnp.int32)[:, None],
+        jnp.asarray(n_active, dtype=jnp.int32).reshape(1),
+        tables.ptm_t,
+        ordered.p0_o[:, None, :],
+        ordered.p1_o[:, None, :],
+        ordered.lag_o[:, None, :],
+        ordered.tails0,
+        ordered.tails1,
+        ordered.msel0[:, None, :],
+        ordered.msel1[:, None, :],
+        ordered.jorder,
+    )
+    return out[:R, 0]

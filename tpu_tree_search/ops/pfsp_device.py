@@ -326,8 +326,10 @@ def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     from . import pallas_kernels as PK
 
     # The kernel covers every Taillard size (20-500 jobs): _auto_tile shrinks
-    # the batch tile as n grows so the VMEM-resident pass always fits.
-    if PK.use_pallas(device) and prmu.shape[-1] <= 512:
+    # the batch tile as n grows; shapes that cannot fit VMEM even at the
+    # smallest tile stay on the jnp oracle.
+    n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    if PK.use_pallas(device) and n <= 512 and PK.lb1_kernel_feasible(n, m):
         return PK.pfsp_lb1_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
             bf16=tables.exact_bf16,
@@ -341,7 +343,8 @@ def lb1_d_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     (`evaluate.cu:51-71` is the per-parent CUDA counterpart)."""
     from . import pallas_kernels as PK
 
-    if PK.use_pallas(device) and prmu.shape[-1] <= 512:
+    n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    if PK.use_pallas(device) and n <= 512 and PK.lb1_kernel_feasible(n, m):
         return PK.pfsp_lb1_d_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
             bf16=tables.exact_bf16,
@@ -360,13 +363,148 @@ def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
 
     # lb2's (P, n, n) slot-order tables cap the kernel at ~100 jobs
     # (ta031-ta090); beyond that the jnp path has the same asymptotic cost.
-    if PK.use_pallas(device) and prmu.shape[-1] <= 100:
+    n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    if (PK.use_pallas(device) and n <= 100
+            and PK.lb2_kernel_feasible(n, m, tables.pairs.shape[0])):
         return PK.pfsp_lb2_bounds(prmu, limit1, tables)
     return _lb2_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
         tables.pairs, tables.lags, tables.johnson_schedules,
         bf16=tables.exact_bf16,
     )
+
+
+@partial(jax.jit, static_argnames=("bf16",))
+def _lb2_self_chunk(
+    prmu,
+    limit1,
+    ptm_t,
+    min_heads,
+    min_tails,
+    pairs,
+    lags,
+    johnson_schedules,
+    bf16: bool = False,
+):
+    """lb2 of each ROW as a node (not of its children): the Johnson bound of
+    the row's own partial schedule (`lb2_bound`, `c_bound_johnson.c:239-254`
+    applied to the node itself). The staged evaluator feeds compacted child
+    rows here — same closed-form max-plus scan as `_lb2_chunk` with the
+    child-expansion axis dropped. Returns (R,) int32."""
+    R, n = prmu.shape
+    front, _, ptg, unsched = _parent_state(prmu, limit1, ptm_t, min_heads, bf16)
+    # Free flags by job id for the row itself.
+    u = jnp.zeros((R, n), dtype=jnp.int32)
+    ridx = jnp.arange(R, dtype=jnp.int32)[:, None]
+    u = u.at[ridx, prmu].set(unsched)  # (R, job)
+
+    P = pairs.shape[0]
+    ptm = ptm_t.T  # (m, n)
+
+    def pair_body(q, lb):
+        ma0 = pairs[q, 0]
+        ma1 = pairs[q, 1]
+        sched = johnson_schedules[q]
+        lag_o = lags[q][sched]
+        p0_o = jnp.take(ptm, ma0, axis=0)[sched]
+        p1_o = jnp.take(ptm, ma1, axis=0)[sched]
+        u_o = jnp.take(u, sched, axis=1)  # (R, n) ordered free flags
+        mp0 = u_o * p0_o[None, :]
+        mp1 = u_o * p1_o[None, :]
+        tmp0_0 = jnp.take_along_axis(
+            front, jnp.broadcast_to(ma0, (R, 1)), axis=1
+        )  # (R, 1)
+        tmp1_0 = jnp.take_along_axis(front, jnp.broadcast_to(ma1, (R, 1)), axis=1)
+        t0 = tmp0_0 + jnp.cumsum(mp0, axis=-1)
+        suf1 = jnp.cumsum(mp1[:, ::-1], axis=-1)[:, ::-1]
+        a = jnp.where(u_o > 0, t0 + lag_o[None, :] + suf1, NEG_INF)
+        tmp1 = jnp.maximum(
+            tmp1_0[:, 0] + jnp.sum(mp1, axis=-1), jnp.max(a, axis=-1)
+        )
+        tmp0 = tmp0_0[:, 0] + jnp.sum(mp0, axis=-1)
+        pair_lb = jnp.maximum(tmp1 + min_tails[ma1], tmp0 + min_tails[ma0])
+        return jnp.maximum(lb, pair_lb)
+
+    lb0 = prmu[:, 0] * 0 + 0 * jnp.min(lags).astype(jnp.int32)
+    return jax.lax.fori_loop(0, P, pair_body, lb0)
+
+
+def lb2_self_bounds(prmu, limit1, n_active, tables: "PFSPDeviceTables",
+                    device=None):
+    """Self lb2 of (R, n) node rows; rows >= ``n_active`` return garbage.
+    On TPU the Pallas kernel skips whole inactive tiles (the
+    incumbent-driven work reduction the reference gets from its per-thread
+    early exit, `evaluate.cu:73-91`); the jnp oracle evaluates everything."""
+    from . import pallas_kernels as PK
+
+    n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    if (PK.use_pallas(device) and n <= 100
+            and PK.lb2_kernel_feasible(n, m, tables.pairs.shape[0])):
+        return PK.pfsp_lb2_self_bounds(prmu, limit1, n_active, tables)
+    return _lb2_self_chunk(
+        prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
+        tables.pairs, tables.lags, tables.johnson_schedules,
+        bf16=tables.exact_bf16,
+    )
+
+
+def lb2_staged_enabled(device=None, n: int | None = None) -> bool:
+    """Staged lb2 (lb1 prefilter -> compacted self-lb2) pays off only where
+    inactive tiles are actually skipped — the Pallas path. TTS_LB2_STAGED=1
+    forces it everywhere (tests exercise the compaction machinery on CPU);
+    =0 disables."""
+    import os
+
+    from . import pallas_kernels as PK
+
+    knob = os.environ.get("TTS_LB2_STAGED", "auto")
+    if knob == "0":
+        return False
+    if knob == "1":
+        return True
+    return PK.use_pallas(device) and (n is None or n <= 100)
+
+
+def lb2_bounds_staged(prmu, limit1, cand, tables: "PFSPDeviceTables",
+                      device=None):
+    """lb2 child bounds evaluated ONLY for candidate children.
+
+    ``cand`` (B, n) marks open, non-leaf children whose lb1 is below the
+    incumbent; since lb2 >= lb1 pointwise (every machine's lb1 term appears
+    as the one-machine term of some Johnson pair), children outside ``cand``
+    are pruned under lb2 too — skipping them is exact. Candidates are
+    compacted to the front of an (R = B*n)-row buffer of materialized child
+    nodes (parent permutation with slots (limit1+1, k) swapped), the self
+    bound runs on ceil(count/tile) active tiles, and results scatter back.
+    Non-candidate slots hold garbage (never read: the caller masks with
+    ``cand``)."""
+    B, n = prmu.shape
+    R = B * n
+    flat = cand.reshape(R)
+    pos = jnp.cumsum(flat.astype(jnp.int32)) - 1  # compacted row per cand
+    count = jnp.sum(flat.astype(jnp.int32))
+    # Scatter each candidate's flat source index into its compacted row
+    # (R+1 buffer: non-candidates target the spill slot, then dropped).
+    tgt = jnp.where(flat, pos, R)
+    src = (
+        jnp.zeros((R + 1,), jnp.int32)
+        .at[tgt]
+        .set(jnp.arange(R, dtype=jnp.int32))[:R]
+    )
+    b_idx = src // n
+    k_idx = src % n
+    parent = prmu[b_idx]  # (R, n)
+    d = limit1[b_idx] + 1  # the child's limit1
+    # Child permutation: swap slots d and k (k == d is a no-op swap).
+    iota = jnp.arange(n, dtype=prmu.dtype)[None, :]
+    vd = jnp.take_along_axis(parent, d[:, None], axis=1)[:, 0]
+    vk = jnp.take_along_axis(parent, k_idx[:, None], axis=1)[:, 0]
+    ohd = (iota == d[:, None]).astype(parent.dtype)
+    ohk = (iota == k_idx[:, None]).astype(parent.dtype)
+    child = parent + ohd * (vk - vd)[:, None] + ohk * (vd - vk)[:, None]
+    out = lb2_self_bounds(child, d, count, tables, device)  # (R,)
+    vals = out[jnp.where(flat, pos, 0)]
+    return vals.reshape(B, n)
 
 
 def lb2_bounds_mp(prmu, limit1, tables: "PFSPDeviceTables", mp_axis: str,

@@ -98,6 +98,10 @@ class _MeshResidentProgram:
         self.inner = _make_program(
             problem, m, M, K, capacity, mesh.devices.flat[0],
             mp_axis="mp" if self.mp > 1 else None, mp_size=self.mp,
+            # Staged lb2's compaction + dynamically-gated self kernel are
+            # unvalidated inside shard_map — the mesh tier stays on the
+            # single-pass evaluator until a hardware round proves them.
+            allow_staged=False,
         )
         self._build()
 
