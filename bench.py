@@ -150,6 +150,36 @@ assert np.array_equal(gq, rq), "nqueens mismatch"
 print("PALLAS_PROBE_OK")
 """
 
+# The staged-lb2 self kernel probes in its OWN subprocess: a compile hang or
+# compiler crash here must only cost the staging (TTS_LB2_STAGED=0), never
+# the whole Pallas path — an in-process try/except cannot catch either
+# failure mode.
+_PROBE_STAGED = r"""
+import sys
+import numpy as np, jax
+if jax.default_backend() != "tpu":
+    print("PALLAS_PROBE_SKIP:" + jax.default_backend())
+    sys.exit(0)
+import jax.numpy as jnp
+from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
+from tpu_tree_search.problems import PFSPProblem
+prob = PFSPProblem(inst=14, lb="lb2", ub=1)
+t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+rng = np.random.default_rng(0)
+B = 256
+prmu = np.tile(np.arange(prob.jobs, dtype=np.int32), (B, 1))
+for i in range(B):
+    rng.shuffle(prmu[i])
+limit1 = rng.integers(0, prob.jobs - 1, size=B).astype(np.int32)
+pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+gs = np.asarray(PK.pfsp_lb2_self_bounds(pd, ld, B, t))
+rs = np.asarray(P._lb2_self_chunk(
+    pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+    t.pairs, t.lags, t.johnson_schedules))
+assert np.array_equal(gs, rs), "lb2_self mismatch"
+print("PALLAS_STAGED_OK")
+"""
+
 
 def backend_alive(timeout_s: float = 240.0) -> tuple[bool, str | None]:
     """One tiny matmul in a subprocess: a dead TPU tunnel hangs backend
@@ -179,7 +209,7 @@ def backend_alive(timeout_s: float = 240.0) -> tuple[bool, str | None]:
     return True, None
 
 
-def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None]:
+def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None, bool]:
     """Compile + oracle-check the PFSP Pallas kernels in a subprocess.
 
     A subprocess (not in-process try/except) because a Mosaic compile can
@@ -190,7 +220,7 @@ def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None]:
     probe.
     """
     if os.environ.get("TTS_PALLAS", "1") == "0":
-        return False, "disabled by TTS_PALLAS=0"
+        return False, "disabled by TTS_PALLAS=0", False
     try:
         res = subprocess.run(
             [sys.executable, "-c", _PROBE],
@@ -199,15 +229,27 @@ def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None]:
             text=True,
         )
     except subprocess.TimeoutExpired:
-        return False, f"probe timed out after {timeout_s:.0f}s (compile hang)"
+        return False, f"probe timed out after {timeout_s:.0f}s (compile hang)", False
     for line in res.stdout.splitlines():
         if line.startswith("PALLAS_PROBE_SKIP:"):
             backend = line.split(":", 1)[1]
-            return False, f"backend is {backend!r}, not tpu"
+            return False, f"backend is {backend!r}, not tpu", False
     if res.returncode != 0 or "PALLAS_PROBE_OK" not in res.stdout:
         tail = (res.stderr or res.stdout).strip().splitlines()[-3:]
-        return False, "probe failed: " + " | ".join(tail)
-    return True, None
+        return False, "probe failed: " + " | ".join(tail), False
+    try:
+        res2 = subprocess.run(
+            [sys.executable, "-c", _PROBE_STAGED],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        staged_ok = (
+            res2.returncode == 0 and "PALLAS_STAGED_OK" in res2.stdout
+        )
+    except subprocess.TimeoutExpired:
+        staged_ok = False
+    return True, None, staged_ok
 
 
 def run_config(problem, m: int, M: int):
@@ -245,9 +287,13 @@ def main() -> int:
         print(json.dumps(err_record))
         return 1
 
-    pallas_ok, pallas_err = probe_pallas()
+    pallas_ok, pallas_err, staged_ok = probe_pallas()
     if not pallas_ok:
         os.environ["TTS_PALLAS"] = "0"
+    if not staged_ok:
+        # The lb2 staging is an optimization over the already-correct
+        # single-pass kernel path; a self-kernel failure costs only that.
+        os.environ["TTS_LB2_STAGED"] = "0"
 
     import jax
 
@@ -308,6 +354,8 @@ def main() -> int:
             ),
             "explored_tree": res2.explored_tree,
             "makespan": res2.best,
+            "staged": staged_ok
+            and os.environ.get("TTS_LB2_STAGED", "auto") != "0",
         })
     except Exception as e:  # noqa: BLE001
         extras.append({

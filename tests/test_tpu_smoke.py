@@ -110,3 +110,51 @@ def test_lb1_d_kernel_compiles_on_tpu(pfsp14):
         P._lb1_d_chunk(prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails)
     )
     np.testing.assert_array_equal(got[open_], ref[open_])
+
+
+def test_lb2_self_kernel_compiles_on_tpu(pfsp14):
+    """The staged evaluator's second stage: compile + parity on the active
+    prefix, plus the n_active tile gating on real Mosaic."""
+    import jax.numpy as jnp
+
+    from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
+
+    prob, t, prmu, limit1, _ = pfsp14
+    l1 = np.maximum(limit1, 0)  # self rows always have limit1 >= 0
+    prmu_d, l1_d = jnp.asarray(prmu), jnp.asarray(l1)
+    ref = np.asarray(P._lb2_self_chunk(
+        prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    ))
+    for n_active in (prmu.shape[0], 57):
+        got = np.asarray(
+            PK.pfsp_lb2_self_bounds(prmu_d, l1_d, n_active, t)
+        )
+        np.testing.assert_array_equal(got[:n_active], ref[:n_active])
+
+
+def test_large_instance_lb1_kernel_compiles_on_tpu():
+    """ta031 (50 jobs): the autoscaled tile must survive real Mosaic, not
+    just the interpret-mode model."""
+    import jax.numpy as jnp
+
+    from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
+    from tpu_tree_search.problems import PFSPProblem
+
+    prob = PFSPProblem(inst=31, lb="lb1", ub=1)
+    t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    rng = np.random.default_rng(11)
+    B = 64
+    prmu = np.stack(
+        [rng.permutation(prob.jobs).astype(np.int32) for _ in range(B)]
+    )
+    limit1 = rng.integers(-1, prob.jobs - 1, B).astype(np.int32)
+    open_ = np.arange(prob.jobs)[None, :] >= (limit1[:, None] + 1)
+    prmu_d, l1_d = jnp.asarray(prmu), jnp.asarray(limit1)
+    got = np.asarray(
+        PK.pfsp_lb1_bounds(prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails)
+    )
+    ref = np.asarray(
+        P._lb1_chunk(prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails)
+    )
+    np.testing.assert_array_equal(got[open_], ref[open_])

@@ -244,12 +244,12 @@ def run_tier(problem, args):
         )
     from .parallel.dist import dist_search
 
+    if args.steal_interval is not None:
+        # Only forward when explicitly set — dist_search owns the default.
+        ckpt_pass["steal_interval_s"] = args.steal_interval
     return dist_search(
         problem, m=args.m, M=args.M, D=args.D, perc=args.perc,
         num_hosts=args.hosts, steal=not args.no_steal,
-        steal_interval_s=(
-            0.02 if args.steal_interval is None else args.steal_interval
-        ),
         **ckpt_pass,
     )
 

@@ -85,6 +85,26 @@ def _r128(x: int) -> int:
     return _round_up(x, 128)
 
 
+def _model_bytes(t: int, n: int, m: int, extra_bytes: int,
+                 tn2_copies: int) -> int:
+    """The kernels' modeled VMEM footprint at batch tile ``t`` — the single
+    source of truth shared by the tile chooser and the routing gate.
+    ``tn2_copies`` counts the (T, n, n)-class f32 live values (one-hot +
+    reshape copies for lb1; the pair loop's u_o/cum0/suf1 and their matmul
+    copies push lb2 higher); ``extra_bytes`` adds tile-independent
+    residents (lb2's per-pair tables)."""
+    tn2 = tn2_copies * t * _r8(n) * _r128(n) * 4
+    oh_nt = n * _r8(t) * _r128(n) * 4
+    scan = n * _r8(t) * _r128(m) * 4
+    ptg = t * _r8(n) * _r128(m) * 4
+    chains = 2 * m * t * _r128(n) * 4
+    return tn2 + oh_nt + scan + ptg + chains + extra_bytes
+
+
+def _vmem_budget() -> int:
+    return (_vmem_limit_bytes() or 16 * 2**20) // 2
+
+
 def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
                tn2_copies: int = 3) -> int:
     """Shrink the batch tile until the kernel's modeled VMEM footprint fits.
@@ -93,24 +113,11 @@ def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
     instances (`Taillard.chpl:29-52`); here the same kernel covers 20-500
     jobs by trading batch-tile size for job count — the big matmuls keep
     T*n rows, so MXU utilization survives small T at large n. The model
-    sums the dominant tiled buffers against half the scoped-VMEM budget,
-    halving the tile until it fits (floor 8). ``tn2_copies`` counts the
-    (T, n, n)-class f32 live values (one-hot + reshape copies for lb1; the
-    pair loop's u_o/cum0/suf1 and their matmul copies push lb2 higher);
-    ``extra_bytes`` adds tile-independent residents (lb2's per-pair
-    tables)."""
-    budget = (_vmem_limit_bytes() or 16 * 2**20) // 2
-
-    def bytes_for(t: int) -> int:
-        tn2 = tn2_copies * t * _r8(n) * _r128(n) * 4
-        oh_nt = n * _r8(t) * _r128(n) * 4
-        scan = n * _r8(t) * _r128(m) * 4
-        ptg = t * _r8(n) * _r128(m) * 4
-        chains = 2 * m * t * _r128(n) * 4
-        return tn2 + oh_nt + scan + ptg + chains + extra_bytes
-
+    (``_model_bytes``) is checked against half the scoped-VMEM budget,
+    halving the tile until it fits (floor 8)."""
+    budget = _vmem_budget()
     tile = default
-    while tile > 8 and bytes_for(tile) > budget:
+    while tile > 8 and _model_bytes(tile, n, m, extra_bytes, tn2_copies) > budget:
         # Halve, then align down to the sublane quantum (a non-power-of-two
         # env override must not walk below the floor or mis-align the
         # (tile, n) BlockSpec).
@@ -123,14 +130,12 @@ def _auto_tile_fits(n: int, m: int, default: int, extra_bytes: int = 0,
     """True iff the kernel fits the VMEM model even at the smallest tile —
     the routing gate: shapes that do not fit must stay on the jnp path
     instead of dying inside a Mosaic VMEM OOM."""
-    budget = (_vmem_limit_bytes() or 16 * 2**20) // 2
     tile = _auto_tile(n, m, default, extra_bytes, tn2_copies)
-    tn2 = tn2_copies * tile * _r8(n) * _r128(n) * 4
-    oh_nt = n * _r8(tile) * _r128(n) * 4
-    scan = n * _r8(tile) * _r128(m) * 4
-    ptg = tile * _r8(n) * _r128(m) * 4
-    chains = 2 * m * tile * _r128(n) * 4
-    return tn2 + oh_nt + scan + ptg + chains + extra_bytes <= budget
+    return _model_bytes(tile, n, m, extra_bytes, tn2_copies) <= _vmem_budget()
+
+
+def _lb2_static_extra(n: int, m: int, P: int) -> int:
+    return (P * _r8(n) * _r128(n) + 3 * P * _r128(n) + 2 * P * _r128(m)) * 4
 
 
 def lb1_kernel_feasible(n: int, m: int) -> bool:
@@ -138,10 +143,15 @@ def lb1_kernel_feasible(n: int, m: int) -> bool:
 
 
 def lb2_kernel_feasible(n: int, m: int, P: int) -> bool:
-    static_extra = (P * _r8(n) * _r128(n) + 3 * P * _r128(n)
-                    + 2 * P * _r128(m)) * 4
     return _auto_tile_fits(n, m, _env_tile("TTS_TILE_LB2", 128),
-                           extra_bytes=static_extra, tn2_copies=8)
+                           extra_bytes=_lb2_static_extra(n, m, P),
+                           tn2_copies=8)
+
+
+def lb2_self_kernel_feasible(n: int, m: int, P: int) -> bool:
+    return _auto_tile_fits(n, m, _env_tile("TTS_TILE_LB2SELF", 256),
+                           extra_bytes=_lb2_static_extra(n, m, P),
+                           tn2_copies=6)
 
 
 # ---------------------------------------------------------------------------
@@ -526,14 +536,12 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
     B, n = prmu.shape
     m = tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
-    # Tile-independent residents: the (P, n, n) slot-order one-hots and the
-    # per-pair job/machine tables; the pair loop itself holds ~8
-    # (T, n, n)-class live f32 values (u_child, u_o, cum0, suf1, their
-    # matmul reshape copies) -> tn2_copies=8.
-    static_extra = (P * _r8(n) * _r128(n) + 3 * P * _r128(n)
-                    + 2 * P * _r128(m)) * 4
+    # Tile-independent residents (per-pair tables) via _lb2_static_extra;
+    # the pair loop holds ~8 (T, n, n)-class live f32 values (u_child, u_o,
+    # cum0, suf1, their matmul reshape copies) -> tn2_copies=8.
     tile = min(_auto_tile(n, m, _env_tile("TTS_TILE_LB2", 128),
-                          extra_bytes=static_extra, tn2_copies=8), B)
+                          extra_bytes=_lb2_static_extra(n, m, P),
+                          tn2_copies=8), B)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
@@ -700,10 +708,9 @@ def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
     R, n = prmu.shape
     m = tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
-    static_extra = (P * _r8(n) * _r128(n) + 3 * P * _r128(n)
-                    + 2 * P * _r128(m)) * 4
     tile = min(_auto_tile(n, m, _env_tile("TTS_TILE_LB2SELF", 256),
-                          extra_bytes=static_extra, tn2_copies=6), R)
+                          extra_bytes=_lb2_static_extra(n, m, P),
+                          tn2_copies=6), R)
     Rp = _round_up(R, tile)
     if Rp != R:
         prmu = jnp.pad(prmu, ((0, Rp - R), (0, 0)))

@@ -439,7 +439,7 @@ def lb2_self_bounds(prmu, limit1, n_active, tables: "PFSPDeviceTables",
 
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
     if (PK.use_pallas(device) and n <= 100
-            and PK.lb2_kernel_feasible(n, m, tables.pairs.shape[0])):
+            and PK.lb2_self_kernel_feasible(n, m, tables.pairs.shape[0])):
         return PK.pfsp_lb2_self_bounds(prmu, limit1, n_active, tables)
     return _lb2_self_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
