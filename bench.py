@@ -209,7 +209,9 @@ def backend_alive(timeout_s: float = 240.0) -> tuple[bool, str | None]:
     return True, None
 
 
-def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None, bool]:
+def probe_pallas(
+    timeout_s: float = 300.0,
+) -> tuple[bool, str | None, bool, str | None]:
     """Compile + oracle-check the PFSP Pallas kernels in a subprocess.
 
     A subprocess (not in-process try/except) because a Mosaic compile can
@@ -220,7 +222,7 @@ def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None, bool]:
     probe.
     """
     if os.environ.get("TTS_PALLAS", "1") == "0":
-        return False, "disabled by TTS_PALLAS=0", False
+        return False, "disabled by TTS_PALLAS=0", False, None
     try:
         res = subprocess.run(
             [sys.executable, "-c", _PROBE],
@@ -229,14 +231,15 @@ def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None, bool]:
             text=True,
         )
     except subprocess.TimeoutExpired:
-        return False, f"probe timed out after {timeout_s:.0f}s (compile hang)", False
+        return (False, f"probe timed out after {timeout_s:.0f}s (compile hang)",
+                False, None)
     for line in res.stdout.splitlines():
         if line.startswith("PALLAS_PROBE_SKIP:"):
             backend = line.split(":", 1)[1]
-            return False, f"backend is {backend!r}, not tpu", False
+            return False, f"backend is {backend!r}, not tpu", False, None
     if res.returncode != 0 or "PALLAS_PROBE_OK" not in res.stdout:
         tail = (res.stderr or res.stdout).strip().splitlines()[-3:]
-        return False, "probe failed: " + " | ".join(tail), False
+        return False, "probe failed: " + " | ".join(tail), False, None
     try:
         res2 = subprocess.run(
             [sys.executable, "-c", _PROBE_STAGED],
@@ -244,12 +247,15 @@ def probe_pallas(timeout_s: float = 300.0) -> tuple[bool, str | None, bool]:
             capture_output=True,
             text=True,
         )
-        staged_ok = (
-            res2.returncode == 0 and "PALLAS_STAGED_OK" in res2.stdout
-        )
+        if res2.returncode == 0 and "PALLAS_STAGED_OK" in res2.stdout:
+            staged_ok, staged_err = True, None
+        else:
+            tail = (res2.stderr or res2.stdout).strip().splitlines()[-3:]
+            staged_ok, staged_err = False, "staged probe: " + " | ".join(tail)
     except subprocess.TimeoutExpired:
         staged_ok = False
-    return True, None, staged_ok
+        staged_err = f"staged probe timed out after {timeout_s:.0f}s"
+    return True, None, staged_ok, staged_err
 
 
 def run_config(problem, m: int, M: int):
@@ -287,12 +293,15 @@ def main() -> int:
         print(json.dumps(err_record))
         return 1
 
-    pallas_ok, pallas_err, staged_ok = probe_pallas()
+    pallas_ok, pallas_err, staged_ok, staged_err = probe_pallas()
     if not pallas_ok:
         os.environ["TTS_PALLAS"] = "0"
-    if not staged_ok:
+    if pallas_ok and not staged_ok:
         # The lb2 staging is an optimization over the already-correct
-        # single-pass kernel path; a self-kernel failure costs only that.
+        # single-pass kernel path; a PROVEN self-kernel failure costs only
+        # that. When the probe never ran (non-TPU, Pallas off) the env is
+        # left alone — an explicit TTS_LB2_STAGED=1 (the documented way to
+        # exercise staging off-TPU) must not be clobbered.
         os.environ["TTS_LB2_STAGED"] = "0"
 
     import jax
@@ -354,8 +363,10 @@ def main() -> int:
             ),
             "explored_tree": res2.explored_tree,
             "makespan": res2.best,
-            "staged": staged_ok
-            and os.environ.get("TTS_LB2_STAGED", "auto") != "0",
+            "staged": os.environ.get("TTS_LB2_STAGED", "auto") == "1"
+            or (staged_ok
+                and os.environ.get("TTS_LB2_STAGED", "auto") != "0"),
+            **({"staged_error": staged_err} if staged_err else {}),
         })
     except Exception as e:  # noqa: BLE001
         extras.append({

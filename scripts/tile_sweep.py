@@ -1,0 +1,139 @@
+"""Batch-tile sweep for the Pallas kernels on a real TPU.
+
+The per-kernel tile defaults in `ops/pallas_kernels.py` were chosen from
+measured v5e compile times; this script re-measures compile + steady-state
+throughput per (kernel, tile) so the defaults can be re-tuned when the
+kernels or the toolchain change. Tiles are injected through the TTS_TILE_*
+env knobs (read per call; the pallas_call cache is keyed by tile, so one
+process sweeps all sizes).
+
+Usage (on a TPU machine)::
+
+    python scripts/tile_sweep.py [--kernels lb1,lb1d,lb2,lb2self]
+        [--tiles 32,64,128,256] [--batch 8192] [--inst 14]
+
+Each cell prints compile seconds and children/us; OOM/compile failures are
+recorded per cell, never fatal (the sweep is itself a feasibility probe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+ENV_BY_KERNEL = {
+    "lb1": "TTS_TILE_LB1",
+    "lb1d": "TTS_TILE_LB1D",
+    "lb2": "TTS_TILE_LB2",
+    "lb2self": "TTS_TILE_LB2SELF",
+}
+
+
+def run_cell(kernel: str, tile: int, batch: int, inst: int, reps: int = 20):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_tree_search.ops import pallas_kernels as PK, pfsp_device as P
+    from tpu_tree_search.problems import PFSPProblem
+
+    prob = PFSPProblem(inst=inst, lb="lb1", ub=1)
+    t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    rng = np.random.default_rng(0)
+    prmu = np.tile(np.arange(prob.jobs, dtype=np.int32), (batch, 1))
+    for i in range(batch):
+        rng.shuffle(prmu[i])
+    limit1 = rng.integers(0, prob.jobs - 1, size=batch).astype(np.int32)
+    pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+
+    os.environ[ENV_BY_KERNEL[kernel]] = str(tile)
+    # The model may shrink an infeasible request — report the tile that
+    # actually compiles, or re-tuning would read mislabeled rows.
+    n, m = prob.jobs, prob.machines
+    P_ = t.pairs.shape[0]
+    if kernel in ("lb1", "lb1d"):
+        eff = PK._auto_tile(n, m, tile)
+    elif kernel == "lb2":
+        eff = PK._auto_tile(n, m, tile,
+                            extra_bytes=PK._lb2_static_extra(n, m, P_),
+                            tn2_copies=8)
+    else:
+        eff = PK._auto_tile(n, m, tile,
+                            extra_bytes=PK._lb2_static_extra(n, m, P_),
+                            tn2_copies=6)
+
+    def call():
+        if kernel == "lb1":
+            return PK.pfsp_lb1_bounds(pd, ld, t.ptm_t, t.min_heads, t.min_tails)
+        if kernel == "lb1d":
+            return PK.pfsp_lb1_d_bounds(pd, ld, t.ptm_t, t.min_heads,
+                                        t.min_tails)
+        if kernel == "lb2":
+            return PK.pfsp_lb2_bounds(pd, ld, t)
+        return PK.pfsp_lb2_self_bounds(pd, ld, batch, t)
+
+    t0 = time.perf_counter()
+    call().block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = call()
+    out.block_until_ready()
+    per_call = (time.perf_counter() - t0) / reps
+    children = batch * prob.jobs if kernel != "lb2self" else batch
+    return eff, compile_s, per_call, children / per_call / 1e6
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kernels", default="lb1,lb1d,lb2,lb2self")
+    ap.add_argument("--tiles", default="32,64,128,256")
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--inst", type=int, default=14)
+    ap.add_argument("--timeout", type=float, default=240.0,
+                    help="per-cell subprocess timeout (a pathological "
+                    "Mosaic compile must not eat the sweep)")
+    ap.add_argument("--cell", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.cell:  # subprocess worker: one (kernel, tile) cell
+        kernel, tile = args.cell.split(":")
+        try:
+            eff, c, p, thr = run_cell(kernel, int(tile), args.batch, args.inst)
+            print(f"CELL_OK {eff} {c:.1f} {p * 1e6:.0f} {thr:.2f}")
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            print(f"CELL_FAIL {type(e).__name__}: {e}")
+        return 0
+
+    import subprocess
+
+    print(f"{'kernel':<8} {'tile':>5} {'eff':>5} {'compile_s':>10} "
+          f"{'us/call':>9} {'Mchild/s':>9}")
+    for kernel in args.kernels.split(","):
+        for tile in args.tiles.split(","):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--cell", f"{kernel}:{tile}",
+                   "--batch", str(args.batch), "--inst", str(args.inst)]
+            try:
+                res = subprocess.run(cmd, capture_output=True, text=True,
+                                     timeout=args.timeout)
+                line = next((ln for ln in res.stdout.splitlines()
+                             if ln.startswith("CELL_")), "CELL_FAIL no output")
+            except subprocess.TimeoutExpired:
+                line = f"CELL_FAIL timeout>{args.timeout:.0f}s"
+            if line.startswith("CELL_OK"):
+                _, eff, c, p, thr = line.split()
+                print(f"{kernel:<8} {tile:>5} {eff:>5} {c:>10} {p:>9} "
+                      f"{thr:>9}")
+            else:
+                print(f"{kernel:<8} {tile:>5}       {line[10:]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,3 +122,26 @@ def test_mesh_resident_mp_rejects_non_lb2():
             ),
             m=4, M=64, D=4, mp=2,
         )
+
+
+def test_mesh_staged_lb2_parity(monkeypatch):
+    """Staged lb2 inside shard_map (per-shard compaction + self bound, no
+    collectives) must reproduce the single-pass mesh run node-for-node.
+    TTS_LB2_STAGED=1 forces the staged structure on the CPU mesh (the jnp
+    self path stands in for the kernel)."""
+    ptm = taillard.reduced_instance(14, jobs=10, machines=5)
+    opt = sequential_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm)).best
+
+    monkeypatch.setenv("TTS_LB2_STAGED", "0")
+    base = mesh_resident_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=128, K=8,
+        initial_best=opt,
+    )
+    monkeypatch.setenv("TTS_LB2_STAGED", "1")
+    staged = mesh_resident_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=128, K=8,
+        initial_best=opt,
+    )
+    assert (staged.explored_tree, staged.explored_sol, staged.best) == (
+        base.explored_tree, base.explored_sol, base.best
+    )

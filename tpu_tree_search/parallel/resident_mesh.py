@@ -98,10 +98,12 @@ class _MeshResidentProgram:
         self.inner = _make_program(
             problem, m, M, K, capacity, mesh.devices.flat[0],
             mp_axis="mp" if self.mp > 1 else None, mp_size=self.mp,
-            # Staged lb2's compaction + dynamically-gated self kernel are
-            # unvalidated inside shard_map — the mesh tier stays on the
-            # single-pass evaluator until a hardware round proves them.
-            allow_staged=False,
+            # Staged lb2 runs per-shard (the compaction is pure local ops,
+            # no collectives; Pallas-inside-shard_map is already how the
+            # lb1/lb2 kernels execute in this tier). The mp>1 case keeps
+            # the single-pass evaluator: staging would have to replicate
+            # the candidate mask across the mp axis.
+            allow_staged=self.mp == 1,
         )
         self._build()
 
