@@ -52,20 +52,12 @@ def run_cell(kernel: str, tile: int, batch: int, inst: int, reps: int = 20):
     pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
 
     os.environ[ENV_BY_KERNEL[kernel]] = str(tile)
-    # The model may shrink an infeasible request — report the tile that
-    # actually compiles, or re-tuning would read mislabeled rows.
-    n, m = prob.jobs, prob.machines
-    P_ = t.pairs.shape[0]
-    if kernel in ("lb1", "lb1d"):
-        eff = PK._auto_tile(n, m, tile)
-    elif kernel == "lb2":
-        eff = PK._auto_tile(n, m, tile,
-                            extra_bytes=PK._lb2_static_extra(n, m, P_),
-                            tn2_copies=8)
-    else:
-        eff = PK._auto_tile(n, m, tile,
-                            extra_bytes=PK._lb2_static_extra(n, m, P_),
-                            tn2_copies=6)
+    # The model may shrink an infeasible request (and batch clamps it) —
+    # report the tile that actually compiles via the kernels' own
+    # effective_tile, or re-tuning would read mislabeled rows.
+    eff = PK.effective_tile(
+        kernel, prob.jobs, prob.machines, t.pairs.shape[0], batch=batch
+    )
 
     def call():
         if kernel == "lb1":

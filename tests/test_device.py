@@ -86,3 +86,33 @@ def test_pfsp_device_diagnostics_counted():
     assert d.kernel_launches > 0
     assert d.host_to_device == d.kernel_launches
     assert d.device_to_host == d.kernel_launches
+
+
+def test_offload_staged_lb2_parity(monkeypatch):
+    """The offload evaluator's staged lb2 (where(cand, self_lb2, lb1)) must
+    reproduce the single-pass run node-for-node: lb1-dead children report
+    lb1 >= dispatch-time best, which the host prunes identically since its
+    running best only tightens."""
+    ptm = T.reduced_instance(14, jobs=10, machines=5)
+    opt = sequential_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm)).best
+
+    monkeypatch.setenv("TTS_LB2_STAGED", "0")
+    base = device_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=256, initial_best=opt
+    )
+    monkeypatch.setenv("TTS_LB2_STAGED", "1")
+    staged = device_search(
+        PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=256, initial_best=opt
+    )
+    assert (staged.explored_tree, staged.explored_sol, staged.best) == (
+        base.explored_tree, base.explored_sol, base.best
+    )
+
+    # Improving incumbent: the host tightens best inside chunks.
+    monkeypatch.setenv("TTS_LB2_STAGED", "0")
+    base2 = device_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=256)
+    monkeypatch.setenv("TTS_LB2_STAGED", "1")
+    staged2 = device_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm), m=8, M=256)
+    assert (staged2.explored_tree, staged2.explored_sol, staged2.best) == (
+        base2.explored_tree, base2.explored_sol, base2.best
+    )

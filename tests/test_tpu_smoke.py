@@ -133,16 +133,20 @@ def test_lb2_self_kernel_compiles_on_tpu(pfsp14):
         np.testing.assert_array_equal(got[:n_active], ref[:n_active])
 
 
-def test_mesh_staged_lb2_runs_on_tpu():
+def test_mesh_staged_lb2_runs_on_tpu(monkeypatch):
     """The combination the CPU suite cannot reach: the staged lb2
     evaluator (compaction + pl.when-gated self kernel with its traced
     n_active scalar) INSIDE shard_map on real Mosaic — the default mesh
-    path for lb2/mp=1 on TPU. Reduced instance keeps the wall-clock down;
-    parity against the sequential count is exact."""
+    path for lb2/mp=1 on TPU. TTS_LB2_STAGED=1 pins the path under test
+    (an exported =0 or a future auto-gate change must not silently turn
+    this into a single-pass run). Reduced instance keeps the wall-clock
+    down; parity against the sequential count is exact."""
     from tpu_tree_search.engine.sequential import sequential_search
     from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
     from tpu_tree_search.problems import PFSPProblem
     from tpu_tree_search.problems.pfsp import taillard
+
+    monkeypatch.setenv("TTS_LB2_STAGED", "1")
 
     ptm = taillard.reduced_instance(14, jobs=10, machines=5)
     opt = sequential_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm)).best

@@ -138,20 +138,49 @@ def _lb2_static_extra(n: int, m: int, P: int) -> int:
     return (P * _r8(n) * _r128(n) + 3 * P * _r128(n) + 2 * P * _r128(m)) * 4
 
 
+# The single source of truth for each kernel's VMEM-model parameters:
+# (tile env knob, measured tile default, tn2_copies, needs per-pair extra).
+_KERNEL_MODEL = {
+    "lb1": ("TTS_TILE_LB1", 64, 3, False),
+    "lb1d": ("TTS_TILE_LB1D", 256, 3, False),
+    "lb2": ("TTS_TILE_LB2", 128, 8, True),
+    "lb2self": ("TTS_TILE_LB2SELF", 256, 6, True),
+}
+
+
+def _kernel_tile_args(kernel: str, n: int, m: int, P: int | None):
+    env, default, copies, pairwise = _KERNEL_MODEL[kernel]
+    extra = _lb2_static_extra(n, m, P) if pairwise else 0
+    return _env_tile(env, default), extra, copies
+
+
+def effective_tile(kernel: str, n: int, m: int, P: int | None = None,
+                   batch: int | None = None) -> int:
+    """The batch tile a kernel will actually use for shape (n, m[, P]) —
+    shared by the feasibility gates, the kernel callers, and
+    scripts/tile_sweep.py so the model constants live in exactly one
+    place."""
+    default, extra, copies = _kernel_tile_args(kernel, n, m, P)
+    tile = _auto_tile(n, m, default, extra_bytes=extra, tn2_copies=copies)
+    return tile if batch is None else min(tile, batch)
+
+
+def _kernel_feasible(kernel: str, n: int, m: int, P: int | None) -> bool:
+    default, extra, copies = _kernel_tile_args(kernel, n, m, P)
+    return _auto_tile_fits(n, m, default, extra_bytes=extra,
+                           tn2_copies=copies)
+
+
 def lb1_kernel_feasible(n: int, m: int) -> bool:
-    return _auto_tile_fits(n, m, _env_tile("TTS_TILE_LB1", 64))
+    return _kernel_feasible("lb1", n, m, None)
 
 
 def lb2_kernel_feasible(n: int, m: int, P: int) -> bool:
-    return _auto_tile_fits(n, m, _env_tile("TTS_TILE_LB2", 128),
-                           extra_bytes=_lb2_static_extra(n, m, P),
-                           tn2_copies=8)
+    return _kernel_feasible("lb2", n, m, P)
 
 
 def lb2_self_kernel_feasible(n: int, m: int, P: int) -> bool:
-    return _auto_tile_fits(n, m, _env_tile("TTS_TILE_LB2SELF", 256),
-                           extra_bytes=_lb2_static_extra(n, m, P),
-                           tn2_copies=6)
+    return _kernel_feasible("lb2self", n, m, P)
 
 
 # ---------------------------------------------------------------------------
@@ -357,15 +386,16 @@ def _lb1_family_call(kernel_fn, n: int, m: int, B: int, tile: int,
 
 def _lb1_family_bounds(
     kernel_fn, prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool,
-    bf16: bool = False, tile_env: str = "TTS_TILE_LB1", tile_default: int = 64,
+    bf16: bool = False, kernel_name: str = "lb1",
 ):
     B, n = prmu.shape
     m = ptm_t.shape[1]
-    # Per-kernel tile defaults are measured, not uniform: Mosaic compile time
-    # for the lb1 kernel grows superlinearly with the batch tile (64 -> ~16s,
-    # 128 -> >270s on v5e), while lb1_d compiles at 256 in ~50s. Large
-    # instances then shrink the tile further until the VMEM model fits.
-    tile = min(_auto_tile(n, m, _env_tile(tile_env, tile_default)), B)
+    # Per-kernel tile defaults are measured, not uniform (_KERNEL_MODEL):
+    # Mosaic compile time for the lb1 kernel grows superlinearly with the
+    # batch tile (64 -> ~16s, 128 -> >270s on v5e), while lb1_d compiles at
+    # 256 in ~50s. Large instances then shrink the tile further until the
+    # VMEM model fits.
+    tile = effective_tile(kernel_name, n, m, batch=B)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
@@ -413,7 +443,7 @@ def pfsp_lb1_d_bounds(
     """(B, n) int32 lb1_d child bounds; same contract as `_lb1_d_chunk`."""
     return _lb1_family_bounds(
         _lb1_d_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
-        bf16, tile_env="TTS_TILE_LB1D", tile_default=256,
+        bf16, kernel_name="lb1d",
     )
 
 
@@ -536,12 +566,9 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
     B, n = prmu.shape
     m = tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
-    # Tile-independent residents (per-pair tables) via _lb2_static_extra;
-    # the pair loop holds ~8 (T, n, n)-class live f32 values (u_child, u_o,
-    # cum0, suf1, their matmul reshape copies) -> tn2_copies=8.
-    tile = min(_auto_tile(n, m, _env_tile("TTS_TILE_LB2", 128),
-                          extra_bytes=_lb2_static_extra(n, m, P),
-                          tn2_copies=8), B)
+    # Tile-independent residents (per-pair tables) + ~8 (T, n, n)-class
+    # live f32 pair-loop values — see _KERNEL_MODEL["lb2"].
+    tile = effective_tile("lb2", n, m, P, batch=B)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
@@ -708,9 +735,7 @@ def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
     R, n = prmu.shape
     m = tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
-    tile = min(_auto_tile(n, m, _env_tile("TTS_TILE_LB2SELF", 256),
-                          extra_bytes=_lb2_static_extra(n, m, P),
-                          tn2_copies=6), R)
+    tile = effective_tile("lb2self", n, m, P, batch=R)
     Rp = _round_up(R, tile)
     if Rp != R:
         prmu = jnp.pad(prmu, ((0, Rp - R), (0, 0)))

@@ -551,9 +551,39 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):
             del count, best
             return lb1_d_bounds(parents["prmu"], parents["limit1"], tables, device)
     elif lb == "lb2":
-        def evaluate(parents, count, best):
-            del count, best
-            return lb2_bounds(parents["prmu"], parents["limit1"], tables, device)
+        if lb2_staged_enabled(device, tables.ptm_t.shape[0]):
+            @jax.jit
+            def _staged(prmu, limit1, count, best):
+                # Offload-path staging: children killed by the cheap lb1
+                # pass report their lb1 value (>= the dispatch-time best,
+                # so the host prunes them identically — lb2 >= lb1 and the
+                # host's running best only tightens); candidates report
+                # the compacted self lb2. Leaf slots report lb1 = exact
+                # makespan, so the host's incumbent fold is unchanged.
+                # ``count`` masks the bucket-padding clone rows out of the
+                # candidate set (their result slots are never read, but
+                # they would inflate the compaction and waste kernel
+                # tiles).
+                n = prmu.shape[-1]
+                bounds1 = lb1_bounds(prmu, limit1, tables, device)
+                kk = jnp.arange(n, dtype=jnp.int32)[None, :]
+                valid = (
+                    jnp.arange(prmu.shape[0], dtype=jnp.int32) < count
+                )[:, None]
+                open_ = (kk >= (limit1 + 1)[:, None]) & valid
+                leaf = open_ & ((limit1[:, None] + 2) == n)
+                cand = open_ & (~leaf) & (bounds1 < best)
+                b2 = lb2_bounds_staged(prmu, limit1, cand, tables, device)
+                return jnp.where(cand, b2, bounds1)
+
+            def evaluate(parents, count, best):
+                return _staged(parents["prmu"], parents["limit1"], count, best)
+        else:
+            def evaluate(parents, count, best):
+                del count, best
+                return lb2_bounds(
+                    parents["prmu"], parents["limit1"], tables, device
+                )
     else:
         raise ValueError(f"Unsupported lower bound: {lb!r}")
     return evaluate
