@@ -572,6 +572,14 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):
                 )[:, None]
                 open_ = (kk >= (limit1 + 1)[:, None]) & valid
                 leaf = open_ & ((limit1[:, None] + 2) == n)
+                # Fold this chunk's leaf makespans before selecting
+                # candidates (as the resident staged path does): the host
+                # folds leaves before its keep test anyway, so children a
+                # leaf already dominates would be pruned regardless —
+                # don't spend kernel tiles on them.
+                best = jnp.minimum(
+                    best, jnp.min(jnp.where(leaf, bounds1, jnp.int32(2**30)))
+                )
                 cand = open_ & (~leaf) & (bounds1 < best)
                 b2 = lb2_bounds_staged(prmu, limit1, cand, tables, device)
                 return jnp.where(cand, b2, bounds1)
