@@ -349,9 +349,9 @@ def main() -> int:
     try:
         # CPU smoke: small chunks — the jnp lb2's per-pair (B, n, n)
         # intermediates make huge chunks crawl without the TPU's bandwidth.
+        lb2_m, lb2_M = 25, (65536 if on_tpu else 4096)
         res2, nps2, _, _ = run_config(
-            PFSPProblem(inst=14, lb="lb2", ub=1), m=25,
-            M=65536 if on_tpu else 4096,
+            PFSPProblem(inst=14, lb="lb2", ub=1), m=lb2_m, M=lb2_M
         )
         staged_speedup = None
         if staged_ok and os.environ.get("TTS_LB2_STAGED", "auto") != "0":
@@ -366,7 +366,7 @@ def main() -> int:
             os.environ["TTS_LB2_STAGED"] = "0"
             try:
                 _, nps2_off, _, _ = run_config(
-                    PFSPProblem(inst=14, lb="lb2", ub=1), m=25, M=65536
+                    PFSPProblem(inst=14, lb="lb2", ub=1), m=lb2_m, M=lb2_M
                 )
                 staged_speedup = round(nps2 / max(nps2_off, 1e-9), 3)
             except Exception:  # noqa: BLE001 — comparison is best-effort

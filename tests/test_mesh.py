@@ -51,7 +51,13 @@ def test_pfsp_mesh_matches_unsharded(lb, mp):
     parents = _random_parents(8, 16, depth=3, limit1=2)
     bounds, nbest = ev(parents, 16, 10**9)
     ref = prob.make_device_evaluator()(parents, 16, 10**9)
-    assert np.array_equal(np.asarray(bounds), np.asarray(ref))
+    # Open child slots only (k > limit1): closed slots hold garbage by
+    # contract, and the staged lb2 evaluator (TTS_LB2_STAGED=1) emits
+    # different garbage there than the single-pass path.
+    open_ = np.arange(8) > 2  # k > limit1 (the fixture's limit1=2)
+    assert np.array_equal(
+        np.asarray(bounds)[:, open_], np.asarray(ref)[:, open_]
+    )
     assert nbest == 10**9  # no leaf children at depth 3 of 8
 
 

@@ -577,8 +577,10 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):
                 # folds leaves before its keep test anyway, so children a
                 # leaf already dominates would be pruned regardless —
                 # don't spend kernel tiles on them.
+                from ..problems.base import INF_BOUND
+
                 best = jnp.minimum(
-                    best, jnp.min(jnp.where(leaf, bounds1, jnp.int32(2**30)))
+                    best, jnp.min(jnp.where(leaf, bounds1, INF_BOUND))
                 )
                 cand = open_ & (~leaf) & (bounds1 < best)
                 b2 = lb2_bounds_staged(prmu, limit1, cand, tables, device)
