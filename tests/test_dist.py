@@ -318,6 +318,26 @@ res = dist_search(NQueensProblem(N=10), m=5, M=256, D=2,
 assert res.explored_tree == 35538, res.explored_tree
 assert res.explored_sol == 724, res.explored_sol
 assert res.comm is not None and res.comm["rounds"] > 0
+
+# Checkpoint/resume through the real coordination service: the comm-round
+# cut + two-phase commit (allgather of staging OKs, atomic rename) runs
+# over actual jax.distributed collectives; resume must hit the goldens.
+import os, tempfile
+ckpt = os.path.join(tempfile.gettempdir(), f"tts_2proc_{port}.ckpt")
+for stale in (f"{ckpt}.h{rank}", f"{ckpt}.h{rank}.staging"):
+    # A prior run's files must not green-light a broken checkpoint path.
+    if os.path.exists(stale):
+        os.remove(stale)
+# interval 0.0: the cut fires on the second comm round, guaranteeing a
+# file before quiescence (which itself needs two further idle rounds).
+res2 = dist_search(NQueensProblem(N=10), m=5, M=256, D=2,
+                   steal_interval_s=0.005, checkpoint_path=ckpt,
+                   checkpoint_interval_s=0.0)
+assert res2.explored_tree == 35538
+assert os.path.exists(f"{ckpt}.h{rank}"), "per-host cut missing"
+res3 = dist_search(NQueensProblem(N=10), m=5, M=256, D=2,
+                   steal_interval_s=0.005, resume_from=ckpt)
+assert res3.explored_tree == 35538 and res3.explored_sol == 724
 print(f"RANK{rank}_OK donations={res.comm['blocks_received']}")
 """
 

@@ -112,6 +112,8 @@ def test_use_pallas_routes_per_device():
     [
         ("lb1", 31, 50, 5),     # ta031 class
         ("lb1", 61, 100, 5),    # ta061 class
+        ("lb1", 91, 200, 10),   # ta091 class
+        ("lb1", 111, 500, 20),  # ta111 class — the reference's largest
         ("lb1_d", 31, 50, 5),
         ("lb2", 31, 50, 5),
         ("lb2", 61, 100, 5),
@@ -127,7 +129,7 @@ def test_large_instance_kernels_match_oracle(lb, inst, jobs, machines):
     prob = PFSPProblem(inst=inst, lb=lb, ub=1)
     assert prob.jobs == jobs and prob.machines == machines
     t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
-    B = 24
+    B = 24 if jobs <= 100 else 8  # interpret mode: keep 200/500-job cheap
     prmu = np.stack([rng.permutation(jobs).astype(np.int32) for _ in range(B)])
     limit1 = rng.integers(-1, jobs - 1, B).astype(np.int32)
     pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
