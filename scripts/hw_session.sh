@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# One-shot hardware session: run this whenever the TPU tunnel is up.
+# Produces: smoke-test results, a tile sweep table, and a bench line
+# (which also refreshes BENCH_LAST_GOOD.json). Each stage is
+# independently timeboxed so a hang cannot eat the window.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== 1/4 backend liveness =="
+if ! timeout 120 python -c "import jax; print(jax.devices())"; then
+  echo "TPU unreachable — aborting hardware session"; exit 1
+fi
+
+echo "== 2/4 Pallas smoke gate (hardware compiles + oracle parity) =="
+TTS_TPU_TESTS=1 timeout 3000 python -m pytest tests/test_tpu_smoke.py -v
+
+echo "== 3/4 tile sweep (per-kernel compile/throughput; informational) =="
+timeout 3000 python scripts/tile_sweep.py || true
+
+echo "== 4/4 bench (writes BENCH_LAST_GOOD.json on success) =="
+timeout 3000 python bench.py
+
+echo "Done. Update docs/HW_VALIDATION.md with the results."
