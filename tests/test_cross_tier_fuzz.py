@@ -18,10 +18,7 @@ from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
 from tpu_tree_search.problems import PFSPProblem
 
 
-@pytest.mark.parametrize(
-    "seed,lb", [(11, "lb1"), (23, "lb1_d"), (47, "lb2")]
-)
-def test_all_tiers_match_sequential_on_random_instance(seed, lb):
+def _fuzz_all_tiers(seed: int, lb: str):
     rng = np.random.default_rng(seed)
     jobs = int(rng.integers(6, 9))
     machines = int(rng.integers(3, 6))
@@ -62,42 +59,17 @@ def test_all_tiers_match_sequential_on_random_instance(seed, lb):
         assert res.best == opt
 
 
+@pytest.mark.parametrize(
+    "seed,lb", [(11, "lb1"), (23, "lb1_d"), (47, "lb2")]
+)
+def test_all_tiers_match_sequential_on_random_instance(seed, lb):
+    _fuzz_all_tiers(seed, lb)
+
+
 @pytest.mark.parametrize("seed", [59, 83])
 def test_all_tiers_match_sequential_staged_lb2(seed, monkeypatch):
     """The staged lb2 evaluator (forced via TTS_LB2_STAGED=1; the jnp self
-    path stands in for the kernel on CPU) through every tier at once on a
-    random instance — the same determinism invariant as the unstaged fuzz."""
+    path stands in for the kernel on CPU) through every tier at once —
+    the same determinism invariant, same shared body."""
     monkeypatch.setenv("TTS_LB2_STAGED", "1")
-    rng = np.random.default_rng(seed)
-    jobs = int(rng.integers(6, 9))
-    machines = int(rng.integers(3, 6))
-    ptm = np.ascontiguousarray(
-        rng.integers(1, 100, size=(machines, jobs)).astype(np.int32)
-    )
-
-    def mk():
-        return PFSPProblem(lb="lb2", ub=0, p_times=ptm)
-
-    opt = sequential_search(mk()).best
-    seq = sequential_search(mk(), initial_best=opt)
-    golden = (seq.explored_tree, seq.explored_sol)
-
-    results = {
-        "device": device_search(mk(), m=4, M=64, initial_best=opt),
-        "resident": resident_search(mk(), m=4, M=64, K=8, initial_best=opt),
-        "mesh": mesh_resident_search(
-            mk(), m=4, M=64, K=4, rounds=2, D=4, initial_best=opt
-        ),
-        "multi": multidevice_search(mk(), m=4, M=64, D=3, initial_best=opt),
-        "dist": dist_search(
-            mk(), m=4, M=64, D=2, num_hosts=2, initial_best=opt,
-            steal_interval_s=0.005,
-        ),
-    }
-    for tier, res in results.items():
-        assert (res.explored_tree, res.explored_sol) == golden, (
-            f"staged {tier} diverged on seed={seed} jobs={jobs} "
-            f"machines={machines}: "
-            f"{(res.explored_tree, res.explored_sol)} != {golden}"
-        )
-        assert res.best == opt
+    _fuzz_all_tiers(seed, "lb2")

@@ -340,6 +340,26 @@ def result_record(args, res) -> dict:
         rec.update(inst=args.inst, lb=args.lb, ub=args.ub, optimum=res.best)
     else:
         rec.update(N=args.N, g=args.g)
+    if args.tier != "seq":
+        # Which evaluation path the run's configuration selects — lets a
+        # stats line prove the hot path was active (the reference's runs
+        # are implicitly kernel-or-nothing; here the jnp fallback is silent
+        # by design). Re-derived from the same inputs the evaluator
+        # builders use (default backend + job count); a run that pins
+        # chunks to a non-default device would need the decision captured
+        # in diagnostics instead.
+        from .ops import pallas_kernels as PK
+
+        rec["pallas"] = PK.use_pallas()
+        if args.problem == "pfsp" and args.lb == "lb2" and args.mp == 1:
+            # mp > 1 shards the pair loop and never stages. The job count
+            # matters: auto mode only stages at n <= 100.
+            from .ops import pfsp_device as P
+            from .problems.pfsp import taillard
+
+            rec["lb2_staged"] = P.lb2_staged_enabled(
+                None, taillard.nb_jobs(args.inst)
+            )
     return rec
 
 
