@@ -11,8 +11,10 @@ N-Queens N=15 (sol 2279184) — BASELINE.md configs 2/4 anchors.
 *recorded* value of this benchmark on this hardware — 1,414,503 nodes/s,
 verified on the real v5e chip in the round-2 review (`TTS_PALLAS=0
 python bench.py`). The reference repo publishes no in-repo numbers
-(`published: {}` in BASELINE.json), so this self-anchor is the honest floor;
-later rounds show relative progress.
+(`published: {}` in BASELINE.json), so this self-anchor shows relative
+progress across rounds; the *external* anchors are ``vs_ref_c_seq`` /
+``vs_ref_c_lb1d`` — the reference's own C sequential programs measured on
+this host (REF_C_SEQ below, BASELINE.md).
 
 Robustness (the reference always emits its stats line,
 `pfsp_gpu_cuda.c:140-148` — so must we): the Pallas kernels are probed in a
@@ -36,6 +38,21 @@ import time
 # Self-anchored baseline: first recorded nodes/sec of the headline benchmark
 # on the v5e chip (round-2 review, jnp path — see module docstring).
 REFERENCE_NODES_PER_SEC = 1_414_503.0
+
+# External, non-circular anchors: the reference's own C sequential programs
+# (`baselines/pfsp/pfsp_c.c`, `baselines/nqueens/nqueens_c.c`) built with
+# gcc -O3 and measured on this host's Xeon @2.10GHz, single core, best of 3
+# with full tree/sol/makespan parity (see BASELINE.md "Measured reference C
+# sequential baselines"). The headline ratio ``vs_ref_c_seq`` divides by the
+# same-bound-variant anchor; ``vs_ref_c_lb1d`` uses the reference's fastest
+# CPU formulation of the same tree (lb1_d) as a second honesty anchor.
+REF_C_SEQ = {
+    "pfsp_ta014_lb1": 927_909.0,
+    "pfsp_ta014_lb1_d": 3_899_473.0,
+    "pfsp_ta014_lb2": 65_391.0,
+    "nqueens_n14": 10_471_617.0,
+    "nqueens_n15": 9_942_907.0,
+}
 
 GOLDEN_LB1 = {"tree": 2_573_652, "sol": 2648, "makespan": 1377}
 GOLDEN_LB2 = {"tree": 144_639, "sol": 0, "makespan": 1377}
@@ -98,6 +115,7 @@ def record_last_good(record: dict) -> None:
                 "metric": record["metric"],
                 "value": record["value"],
                 "vs_baseline": record["vs_baseline"],
+                "vs_ref_c_seq": record.get("vs_ref_c_seq"),
                 "pallas": record.get("pallas", False),
                 "commit": _git_head(),
                 "date": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
@@ -325,6 +343,8 @@ def main() -> int:
             "value": round(nps, 1),
             "unit": "nodes/sec",
             "vs_baseline": round(nps / REFERENCE_NODES_PER_SEC, 3),
+            "vs_ref_c_seq": round(nps / REF_C_SEQ["pfsp_ta014_lb1"], 3),
+            "vs_ref_c_lb1d": round(nps / REF_C_SEQ["pfsp_ta014_lb1_d"], 3),
             "parity": parity,
             "explored_tree": res.explored_tree,
             "explored_sol": res.explored_sol,
@@ -379,6 +399,7 @@ def main() -> int:
         extras.append({
             "metric": "pfsp_ta014_lb2_nodes_per_sec_per_chip",
             "value": round(nps2, 1),
+            "vs_ref_c_seq": round(nps2 / REF_C_SEQ["pfsp_ta014_lb2"], 3),
             "parity": (
                 res2.explored_tree == GOLDEN_LB2["tree"]
                 and res2.explored_sol == GOLDEN_LB2["sol"]
@@ -404,6 +425,8 @@ def main() -> int:
         extras.append({
             "metric": f"nqueens_n{N}_nodes_per_sec_per_chip",
             "value": round(npsq, 1),
+            **({"vs_ref_c_seq": round(npsq / REF_C_SEQ[f"nqueens_n{N}"], 3)}
+               if f"nqueens_n{N}" in REF_C_SEQ else {}),
             "parity": resq.explored_sol == NQ_SOL[N],
             "explored_tree": resq.explored_tree,
             "explored_sol": resq.explored_sol,

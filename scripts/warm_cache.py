@@ -1,0 +1,86 @@
+"""Warm the persistent XLA/Mosaic compile cache for the validation matrix.
+
+A Mosaic compile on the real chip can cost minutes (lb1 tile-128 measured
+>270s) and tunnel windows are short — so during any green window this script
+compiles every program the bench and the smoke gate need, storing the
+executables in the version-keyed compile cache (`cli.enable_compile_cache`).
+A second session then starts from a hot cache: bench's numbers stop being
+hostage to compile time, and its 300s kernel-probe timeout can't silently
+flip the run to the jnp path.
+
+Cache keys include the full program shape, so warming MUST run the exact
+entry points with the exact shapes the consumers use: each config below is
+one ``resident_search(..., max_steps=1)`` — the full while-loop program plus
+its kernels, compiled and executed for a single step. Staged and unstaged
+lb2 are distinct programs; both warm. Each config runs in a subprocess with
+its own timeout (a compile hang must only cost its slot, bench.py's probe
+lesson) and prints wall seconds — re-run to see hits (near-zero seconds).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_ITEM = r"""
+import os, time, sys
+t0 = time.time()
+import jax
+from tpu_tree_search.cli import enable_compile_cache
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+
+enable_compile_cache()
+kind = sys.argv[1]
+if kind == "nqueens":
+    prob = NQueensProblem(N=int(sys.argv[2]))
+else:
+    prob = PFSPProblem(inst=int(sys.argv[2]), lb=sys.argv[3], ub=1)
+M = int(sys.argv[3] if kind == "nqueens" else sys.argv[5])
+res = resident_search(prob, m=25, M=M, max_steps=1)
+print(f"WARM_OK tree={res.explored_tree} wall={time.time() - t0:.1f}s")
+"""
+
+# (label, argv tail, env overrides) — the bench + smoke-gate matrix, most
+# valuable first so a closing window still banks the flagship programs.
+CONFIGS: list[tuple[str, list[str], dict[str, str]]] = [
+    ("ta014 lb2 staged M=65536", ["pfsp", "14", "lb2", "-", "65536"],
+     {"TTS_LB2_STAGED": "1"}),
+    ("ta014 lb2 unstaged M=65536", ["pfsp", "14", "lb2", "-", "65536"],
+     {"TTS_LB2_STAGED": "0"}),
+    ("ta014 lb1 M=65536", ["pfsp", "14", "lb1", "-", "65536"], {}),
+    ("ta014 lb1_d M=65536", ["pfsp", "14", "lb1_d", "-", "65536"], {}),
+    ("nqueens N=15 M=65536", ["nqueens", "15", "65536"], {}),
+    # Large-instance classes (VERDICT r4 #7): ta056 = 50x20, ta111 = 500x20.
+    ("ta056 lb1 M=4096", ["pfsp", "56", "lb1", "-", "4096"], {}),
+    ("ta056 lb2 M=4096", ["pfsp", "56", "lb2", "-", "4096"], {}),
+    ("ta111 lb1 M=1024", ["pfsp", "111", "lb1", "-", "1024"], {}),
+]
+
+
+def main() -> int:
+    timeout_s = float(os.environ.get("TTS_WARM_TIMEOUT", "420"))
+    failures = 0
+    for label, argv, env in CONFIGS:
+        t0 = time.time()
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", _ITEM, *argv],
+                timeout=timeout_s, capture_output=True, text=True,
+                env={**os.environ, **env},
+            )
+            ok = res.returncode == 0 and "WARM_OK" in res.stdout
+            detail = (res.stdout.strip().splitlines() or [""])[-1] if ok else \
+                (res.stderr or res.stdout).strip().splitlines()[-1:]
+        except subprocess.TimeoutExpired:
+            ok, detail = False, f"timeout {timeout_s:.0f}s"
+        failures += not ok
+        print(f"{'ok ' if ok else 'FAIL'} {time.time() - t0:7.1f}s  "
+              f"{label}  {detail}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -252,3 +252,48 @@ def test_dist_tier_checkpoint_resume(tmp_path):
     assert (resumed.explored_tree, resumed.explored_sol) == (
         seq.explored_tree, seq.explored_sol
     )
+
+
+def test_dist_resume_refuses_mismatched_cuts(tmp_path):
+    """Per-host files from DIFFERENT cuts (a host crashing between the
+    two-phase-commit allgather and its os.replace, or stale files from a
+    prior run with the same host count) pass the hosts check but describe an
+    incoherent frontier union — nodes donated between the two rounds would
+    be lost or double-explored (ADVICE r4 medium). Resume must allgather the
+    cut tags and refuse on mismatch; matched tags (the happy path) are
+    covered by test_dist_tier_checkpoint_resume."""
+    import json
+
+    import numpy as np
+
+    from tpu_tree_search.parallel.dist import dist_search
+
+    path = str(tmp_path / "dist.ckpt")
+    prob = NQueensProblem(N=10)
+    dist_search(
+        prob, m=5, M=256, D=1, num_hosts=2, steal_interval_s=0.005,
+        checkpoint_path=path, checkpoint_interval_s=0.0,
+    )
+    tags = []
+    for h in (0, 1):
+        with np.load(path + f".h{h}") as data:
+            header = json.loads(bytes(data["header"]).decode())
+        # Multi-host per-host files write format v3 so pre-v3 readers (no
+        # hosts/cut checks) refuse them instead of resuming one host's
+        # share as the whole frontier (ADVICE r4).
+        assert header["version"] == 3
+        assert header["hosts"] == 2
+        tags.append(header["cut_tag"])
+    # Lockstep cut: the SAME "<run-uuid>:<round>" tag on every host.
+    assert tags[0] == tags[1] and tags[0] is not None
+    assert ":" in str(tags[0])
+
+    # Tamper host 1's file to impersonate a different cut of another run.
+    loaded = ckpt.load(path + ".h1", NQueensProblem(N=10), expect_hosts=2)
+    ckpt.save(path + ".h1", prob, loaded.batch, loaded.best, loaded.tree,
+              loaded.sol, hosts=2, cut_tag="deadbeef0000:999")
+    with pytest.raises(ValueError, match="incoherent multi-host resume"):
+        dist_search(
+            NQueensProblem(N=10), m=5, M=256, D=1, num_hosts=2,
+            steal_interval_s=0.005, resume_from=path,
+        )

@@ -235,6 +235,17 @@ def test_balanced_run_cadence_backs_off():
     )
 
 
+def _free_port() -> int:
+    """Ephemeral port for a jax.distributed coordinator: bind to 0, let the
+    OS pick, release. (Races are possible but vanishingly rarer than a fixed
+    constant colliding with a concurrent run or a leftover listener.)"""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_jax_collectives_single_process_subprocess():
     """JaxCollectives (the real-pod DCN backend) exercised end to end in a
     1-process jax.distributed universe — run in a subprocess because
@@ -249,7 +260,8 @@ os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize("localhost:19793", num_processes=1, process_id=0)
+jax.distributed.initialize("localhost:@PORT@", num_processes=1,
+                           process_id=0)
 from tpu_tree_search.parallel.dist import JaxCollectives, dist_search
 from tpu_tree_search.problems import NQueensProblem
 from tpu_tree_search.engine.sequential import sequential_search
@@ -268,7 +280,7 @@ res = dist_search(NQueensProblem(N=8), m=5, M=64)
 assert res.explored_sol == seq.explored_sol
 assert res.explored_tree == seq.explored_tree
 print("JAX_COLLECTIVES_OK")
-"""
+""".replace("@PORT@", str(_free_port()))
     res = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         timeout=240,
@@ -351,7 +363,7 @@ def test_jax_collectives_two_processes():
     import subprocess
     import sys
 
-    port = 19817
+    port = _free_port()  # a fixed port collides with concurrent CI runs
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _TWO_PROC_WORKER, str(rank), str(port)],

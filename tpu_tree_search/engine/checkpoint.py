@@ -24,7 +24,13 @@ import numpy as np
 
 from ..problems.base import NodeBatch, Problem
 
-FORMAT_VERSION = 2  # v2: PFSP meta carries a p_times digest (ptimes_sha)
+# v2: PFSP meta carries a p_times digest (ptimes_sha).
+# v3: multi-host per-host files (hosts > 1). Single-host files keep writing
+# v2 so older readers still load them; multi-host files write v3 so a
+# pre-v3 reader — which has no hosts/cut coherence checks — refuses them
+# instead of silently resuming one host's share as the whole frontier.
+FORMAT_VERSION = 3
+_SINGLE_HOST_VERSION = 2
 
 
 class RunController:
@@ -76,7 +82,10 @@ class Checkpoint:
     tree: int
     sol: int
     hosts: int = 1  # multi-host sets: total per-host files in this cut
-    cut_tag: int | None = None  # dist tier: communicator round of the cut
+    # Dist tier: identity of the lockstep cut this file belongs to
+    # ("<run-uuid>:<round>", stamped identically on every host of the cut);
+    # older files carry the bare communicator round (int). None = timer cut.
+    cut_tag: int | str | None = None
 
 
 def problem_meta(problem: Problem) -> dict:
@@ -98,9 +107,9 @@ def problem_meta(problem: Problem) -> dict:
 
 
 def save(path: str, problem: Problem, batch: NodeBatch, best: int, tree: int,
-         sol: int, hosts: int = 1, cut_tag: int | None = None) -> None:
+         sol: int, hosts: int = 1, cut_tag: int | str | None = None) -> None:
     header = {
-        "version": FORMAT_VERSION,
+        "version": FORMAT_VERSION if hosts > 1 else _SINGLE_HOST_VERSION,
         "meta": problem_meta(problem),
         "best": int(best),
         "tree": int(tree),
@@ -125,7 +134,7 @@ def load(path: str, problem: Problem, expect_hosts: int = 1) -> Checkpoint:
     (or double-explore) the other hosts' shares — refuse loudly instead."""
     with np.load(path) as data:
         header = json.loads(bytes(data["header"]).decode())
-        if header["version"] not in (1, FORMAT_VERSION):
+        if header["version"] not in (1, _SINGLE_HOST_VERSION, FORMAT_VERSION):
             raise ValueError(f"unsupported checkpoint version {header['version']}")
         want = problem_meta(problem)
         got = dict(header["meta"])

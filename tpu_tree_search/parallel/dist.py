@@ -289,6 +289,16 @@ class _HostComm:
         self.ckpt_mgr = None
         self.ckpt_interval_s = ckpt_interval_s
         self._ckpt_last = None
+        # Cut identity: host 0 proposes "<uuid>:<round>" in the round's
+        # control tuple and every host stamps that exact string into its
+        # per-host file, so resume can prove all files belong to the same
+        # lockstep cut of the same run (stale files from a prior run with
+        # the same host count, or files from two different cuts after a
+        # mid-commit crash, must be refused — they describe incoherent
+        # frontiers).
+        import uuid as _uuid
+
+        self._run_uuid = _uuid.uuid4().hex[:12]
 
     def _donate_from(self, pools):
         """Locked front-steal from the fullest local pool (on behalf of a
@@ -371,8 +381,11 @@ class _HostComm:
                 elif (_time.monotonic() - self._ckpt_last
                       >= self.ckpt_interval_s):
                     want_ckpt = True
+            cut_id = (
+                f"{self._run_uuid}:{self.rounds}" if want_ckpt else None
+            )
             rows = coll.allgather_obj(
-                (size, max_pool, best, bool(idle), want_ckpt)
+                (size, max_pool, best, bool(idle), want_ckpt, cut_id)
             )
             gbest = min(r[2] for r in rows)
             shared.publish(gbest)
@@ -459,7 +472,7 @@ class _HostComm:
 
                 staging = self.ckpt_mgr.path + ".staging"
                 ok = self.ckpt_mgr.do_checkpoint(
-                    to_path=staging, cut_tag=self.rounds
+                    to_path=staging, cut_tag=rows[0][5]
                 )
                 oks = coll.allgather_obj(bool(ok))
                 if all(oks):
@@ -652,7 +665,17 @@ def dist_search(
         t.start()
     for t in threads:
         t.join()
-    for e in errors:
+    # An erroring host aborts the shared barrier, so its PEERS — possibly
+    # including host 0 — die with secondary errors: BrokenBarrierError from
+    # inside a collective, or kv_get's TimeoutError("... (peer aborted)").
+    # Surface the root cause, not whichever error sits at the lowest index.
+    def _secondary(e) -> bool:
+        return isinstance(e, threading.BrokenBarrierError) or (
+            isinstance(e, TimeoutError) and "peer aborted" in str(e)
+        )
+
+    real = [e for e in errors if e is not None and not _secondary(e)]
+    for e in real or errors:
         if e is not None:
             raise e
     # All hosts computed identical global reductions; merge per-host extras.

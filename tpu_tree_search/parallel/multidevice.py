@@ -128,7 +128,7 @@ class CheckpointManager:
         self._thread: threading.Thread | None = None
 
     def do_checkpoint(self, to_path: str | None = None,
-                      cut_tag: int | None = None) -> bool:
+                      cut_tag: int | str | None = None) -> bool:
         """Pause, snapshot, save; returns False (writing nothing) if a
         worker has died — its popped chunk is gone from the pools, so a cut
         would silently lose a subtree. ``to_path`` lets the dist tier stage
@@ -443,6 +443,22 @@ def host_pipeline(
         from ..engine import checkpoint as ckpt_mod
 
         loaded = ckpt_mod.load(eff_resume, problem, expect_hosts=num_hosts)
+        if comm is not None:
+            # Lockstep-cut coherence: every host's file must carry the SAME
+            # cut id ("<run-uuid>:<round>", stamped by _HostComm). Per-host
+            # files from different cuts — a host that crashed between the
+            # two-phase-commit allgather and its os.replace, or stale files
+            # from a prior run with the same host count — would pass the
+            # hosts check yet describe an incoherent frontier union: nodes
+            # donated between the two rounds get lost or double-explored.
+            tags = comm.coll.allgather_obj(loaded.cut_tag)
+            if len(set(tags)) != 1:
+                raise ValueError(
+                    "incoherent multi-host resume: per-host checkpoint "
+                    f"files come from different cuts ({tags}); restore a "
+                    "matching set (same run, same communicator round) "
+                    "before resuming"
+                )
         pool.push_back_bulk(loaded.batch)
         tree1, sol1 = 0, 0
         base_tree, base_sol = loaded.tree, loaded.sol
