@@ -235,6 +235,97 @@ def test_balanced_run_cadence_backs_off():
     )
 
 
+def test_comm_transport_failure_requeues_inflight_donation():
+    """A donation popped from a local pool but never delivered (the
+    transport dies inside kv_set) must be REQUEUED, not lost — the
+    `_inflight` path (VERDICT r4 #8). The reference has no analogue: a
+    crashed locale loses its in-flight steal and hangs allIdle forever
+    (SURVEY.md §5)."""
+    import threading
+
+    import numpy as np
+
+    from tpu_tree_search.parallel.dist import _HostComm
+    from tpu_tree_search.pool import ParallelSoAPool
+
+    m = 5
+
+    class _DyingTransport:
+        """Round 1: host 0 (rich, busy) is matched to donate to host 1
+        (idle, starving); the KV send then dies."""
+
+        num_hosts = 2
+        host_id = 0
+
+        def allgather_obj(self, row):
+            return [row, (0, 0, row[2], True, False, None)]
+
+        def kv_set(self, key, value):
+            raise RuntimeError("transport died mid-donation")
+
+        def kv_get(self, key, timeout_s):
+            raise AssertionError("host 0 never receives")
+
+    class _States:
+        flag = threading.Event()
+
+        def _all_idle(self):
+            return False
+
+    class _Shared:
+        def read(self):
+            return 10**9
+
+        def publish(self, v):
+            return v
+
+    pool = ParallelSoAPool({"x": ((), np.int32)})
+    pool.push_back_bulk({"x": np.arange(100, dtype=np.int32)})
+    comm = _HostComm(_DyingTransport(), m, interval_s=0.0)
+    stop = threading.Event()
+    comm.run([pool], _States(), _Shared(), stop)
+
+    assert isinstance(comm.error, RuntimeError), comm.error
+    assert "mid-donation" in str(comm.error)
+    assert stop.is_set()  # workers unblock instead of polling forever
+    assert comm._inflight is None
+    assert pool.size == 100  # the popped block went back — zero node loss
+
+
+def test_dist_worker_death_aborts_cleanly_with_root_cause():
+    """A worker dying mid-search (evaluator raises) under the full
+    2-virtual-host dist tier with steal churn: every host must stop
+    promptly and dist_search must surface the WORKER's error — not a
+    secondary BrokenBarrierError / kv timeout from a peer that was mid-
+    collective when the abort hit. The reference instead hangs allIdle
+    forever on a crashed task (SURVEY.md §5)."""
+    calls = {"n": 0}
+    orig = NQueensProblem.generate_children
+
+    def dying(self, snapshot, count, results, best):
+        calls["n"] += 1
+        if calls["n"] > 3:  # let some real chunks/steals happen first
+            raise RuntimeError("injected worker death")
+        return orig(self, snapshot, count, results, best)
+
+    def skew(warm, host_id, num_hosts):
+        # Everything on host 0: host 1 only works via donation churn.
+        return {k: (v if host_id == 0 else v[:0]) for k, v in warm.items()}
+
+    import time as _time
+
+    from unittest import mock
+
+    t0 = _time.monotonic()
+    with mock.patch.object(NQueensProblem, "generate_children", dying):
+        with pytest.raises(RuntimeError, match="injected worker death"):
+            dist_search(
+                NQueensProblem(N=10), m=5, M=64, D=2, num_hosts=2,
+                steal_interval_s=0.005, partition_fn=skew,
+            )
+    assert _time.monotonic() - t0 < 60.0  # clean abort, not a hang
+
+
 def _free_port() -> int:
     """Ephemeral port for a jax.distributed coordinator: bind to 0, let the
     OS pick, release. (Races are possible but vanishingly rarer than a fixed
@@ -352,6 +443,65 @@ res3 = dist_search(NQueensProblem(N=10), m=5, M=256, D=2,
 assert res3.explored_tree == 35538 and res3.explored_sol == 724
 print(f"RANK{rank}_OK donations={res.comm['blocks_received']}")
 """
+
+
+_FOUR_PROC_WORKER = """
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"localhost:{port}", num_processes=4,
+                           process_id=rank)
+from tpu_tree_search.parallel.dist import dist_search
+from tpu_tree_search.problems import NQueensProblem
+
+# Every warm-up node lands on host 0: hosts 1-3 contribute ONLY through
+# repeated coordination-service donation rounds (steal churn at 4-host
+# scale; the donor re-matches each round as receivers drain).
+def skew(warm, host_id, num_hosts):
+    return {k: (v if host_id == 0 else v[:0]) for k, v in warm.items()}
+
+res = dist_search(NQueensProblem(N=10), m=5, M=128, D=1,
+                  steal_interval_s=0.005, partition_fn=skew)
+assert res.explored_tree == 35538, res.explored_tree
+assert res.explored_sol == 724, res.explored_sol
+assert res.comm is not None and res.comm["blocks_received"] > 0
+print(f"RANK{rank}_OK donations={res.comm['blocks_received']}")
+"""
+
+
+def test_jax_collectives_four_processes_steal_churn():
+    """Four REAL jax.distributed processes with a fully skewed partition:
+    three starving hosts drain host 0 through repeated donation rounds
+    (VERDICT r4 #8's scale-up of the 2-process test). Parity against the
+    N=10 goldens proves no node was lost or double-explored across the
+    churn."""
+    import subprocess
+    import sys
+
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _FOUR_PROC_WORKER, str(rank), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for rank in range(4)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0 and f"RANK{rank}_OK" in out, (
+            f"rank {rank}: rc={rc}\nstdout: {out[-1000:]}\nstderr: {err[-2000:]}"
+        )
 
 
 def test_jax_collectives_two_processes():

@@ -271,6 +271,81 @@ def test_lb2_dominates_lb1_on_device_evaluators():
         assert np.all(b2[open_] >= b1[open_])
 
 
+def test_lb2_self_mp_shard_maxes_combine_to_full():
+    """The mp-sharded self bound's per-shard pieces (sliced ordered tables
+    through the Pallas kernel, interpret mode) must pmax-combine to exactly
+    the full-pair self bound — including a pair count that needs padding
+    (max over duplicated pair 0 is idempotent)."""
+    rng = np.random.default_rng(37)
+    jobs = 8
+    ptm = taillard.reduced_instance(14, jobs=jobs, machines=5)
+    prob = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    R = 64
+    prmu, limit1 = _random_nodes(rng, jobs, R)
+    pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+    full = np.asarray(pfsp_device._lb2_self_chunk(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    ))
+    for mp_size in (2, 3):  # P=10 pairs: 3 forces padding to 12
+        P_pad = -(-t.pairs.shape[0] // mp_size) * mp_size
+        P_local = P_pad // mp_size
+        ordered = t.johnson_ordered_mp(mp_size)
+        parts = []
+        for shard in range(mp_size):
+            sliced = pfsp_device._OrderedSlice(
+                ordered, shard * P_local, P_local
+            )
+            parts.append(np.asarray(pallas_kernels.pfsp_lb2_self_bounds_tables(
+                pd, ld, R, t.ptm_t, sliced, interpret=True,
+                bf16=t.exact_bf16,
+            )))
+        assert np.array_equal(np.maximum.reduce(parts), full), mp_size
+
+
+def test_lb2_staged_mp_matches_full_inside_shard_map():
+    """lb2_bounds_staged with mp_axis set, run inside a REAL shard_map over
+    an mp-only mesh (2 CPU devices): the compaction runs per replica, the
+    self bound slices its pair block per shard and pmax-combines — results
+    must equal the full child evaluator on every candidate slot, on every
+    replica."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    rng = np.random.default_rng(41)
+    jobs = 8
+    ptm = taillard.reduced_instance(14, jobs=jobs, machines=5)
+    prob = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    B = 32
+    prmu, limit1 = _random_nodes(rng, jobs, B)
+    pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+    full = np.asarray(pfsp_device._lb2_chunk(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    ))
+    open_ = np.arange(jobs)[None, :] >= (limit1[:, None] + 1)
+    leaf = open_ & ((limit1[:, None] + 2) == jobs)
+    cand = open_ & ~leaf & (rng.random((B, jobs)) < 0.5)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("mp",))
+
+    def body(pd, ld, cd):
+        return pfsp_device.lb2_bounds_staged(
+            pd, ld, cd, t, mp_axis="mp", mp_size=2
+        )[None]
+
+    got = np.asarray(jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P()), out_specs=P("mp"),
+    ))(pd, ld, jnp.asarray(cand)))
+    # Every mp replica computed identical full-pair bounds (lockstep).
+    assert np.array_equal(got[0][cand], full[cand])
+    assert np.array_equal(got[1][cand], full[cand])
+
+
 def test_lb2_staged_bounds_match_full_on_candidates():
     """lb2_bounds_staged (compaction + self bound + scatter) equals the full
     child evaluator everywhere the candidate mask is set."""

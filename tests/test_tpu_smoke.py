@@ -162,28 +162,93 @@ def test_mesh_staged_lb2_runs_on_tpu(monkeypatch):
     )
 
 
-def test_large_instance_lb1_kernel_compiles_on_tpu():
-    """ta031 (50 jobs): the autoscaled tile must survive real Mosaic, not
-    just the interpret-mode model."""
+def test_lb2_self_mp_sliced_kernel_compiles_on_tpu(pfsp14):
+    """The mp-staged path's kernel variant — the self kernel over a SLICED
+    pair block (P_local tables instead of the full set) — on real Mosaic,
+    and the pmax-combine identity: per-shard maxes must equal the full-pair
+    self bound. (The shard_map composition itself is CPU-mesh-tested; the
+    single real chip cannot host an mp=2 mesh, but the compile risk lives
+    entirely in the sliced kernel call.)"""
     import jax.numpy as jnp
 
     from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
-    from tpu_tree_search.problems import PFSPProblem
 
-    prob = PFSPProblem(inst=31, lb="lb1", ub=1)
-    t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
-    rng = np.random.default_rng(11)
-    B = 64
+    prob, t, prmu, limit1, _ = pfsp14
+    l1 = np.maximum(limit1, 0)
+    prmu_d, l1_d = jnp.asarray(prmu), jnp.asarray(l1)
+    ref = np.asarray(P._lb2_self_chunk(
+        prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    ))
+    mp_size = 2
+    P_pad = -(-t.pairs.shape[0] // mp_size) * mp_size
+    P_local = P_pad // mp_size
+    ordered = t.johnson_ordered_mp(mp_size)
+    parts = [
+        np.asarray(PK.pfsp_lb2_self_bounds_tables(
+            prmu_d, l1_d, prmu.shape[0], t.ptm_t,
+            P._OrderedSlice(ordered, shard * P_local, P_local),
+            bf16=t.exact_bf16,
+        ))
+        for shard in range(mp_size)
+    ]
+    np.testing.assert_array_equal(np.maximum.reduce(parts), ref)
+
+
+def _random_large(prob, B, seed):
+    rng = np.random.default_rng(seed)
     prmu = np.stack(
         [rng.permutation(prob.jobs).astype(np.int32) for _ in range(B)]
     )
     limit1 = rng.integers(-1, prob.jobs - 1, B).astype(np.int32)
     open_ = np.arange(prob.jobs)[None, :] >= (limit1[:, None] + 1)
+    return prmu, limit1, open_
+
+
+@pytest.mark.parametrize(
+    "inst,lb,B",
+    [
+        (31, "lb1", 64),   # 50 x 10
+        (56, "lb1", 32),   # 50 x 20
+        (56, "lb2", 16),   # 50 x 20, P=190 pairs
+        (111, "lb1", 16),  # 500 x 20
+    ],
+)
+def test_large_instance_kernels_compile_on_tpu(inst, lb, B):
+    """Large Taillard classes through the real Mosaic compiler: the
+    autoscaled tile must survive hardware, not just the interpret-mode VMEM
+    model (the reference instead rebuilds with bigger compile-time params,
+    `Taillard.chpl:29-52`). Skips — visibly — when the feasibility gate
+    routes the shape to the jnp path (then the gate IS the product
+    behavior being validated)."""
+    import jax.numpy as jnp
+
+    from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
+    from tpu_tree_search.problems import PFSPProblem
+
+    prob = PFSPProblem(inst=inst, lb=lb, ub=1)
+    t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    n, m = prob.jobs, prob.machines
+    if lb == "lb2" and not (n <= 100 and PK.lb2_kernel_feasible(
+            n, m, t.pairs.shape[0])):
+        pytest.skip(f"gate routes ta{inst:03d} lb2 to the jnp path")
+    if lb == "lb1" and not (n <= 512 and PK.lb1_kernel_feasible(n, m)):
+        pytest.skip(f"gate routes ta{inst:03d} lb1 to the jnp path")
+    prmu, limit1, open_ = _random_large(prob, B, seed=11 + inst)
     prmu_d, l1_d = jnp.asarray(prmu), jnp.asarray(limit1)
-    got = np.asarray(
-        PK.pfsp_lb1_bounds(prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails)
-    )
-    ref = np.asarray(
-        P._lb1_chunk(prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails)
-    )
+    if lb == "lb1":
+        got = np.asarray(PK.pfsp_lb1_bounds(
+            prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails,
+            bf16=t.exact_bf16,
+        ))
+        ref = np.asarray(P._lb1_chunk(
+            prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails,
+            bf16=t.exact_bf16,
+        ))
+    else:
+        got = np.asarray(PK.pfsp_lb2_bounds(prmu_d, l1_d, t))
+        ref = np.asarray(P._lb2_chunk(
+            prmu_d, l1_d, t.ptm_t, t.min_heads, t.min_tails,
+            t.pairs, t.lags, t.johnson_schedules, bf16=t.exact_bf16,
+        ))
     np.testing.assert_array_equal(got[open_], ref[open_])

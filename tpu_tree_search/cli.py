@@ -351,8 +351,9 @@ def result_record(args, res) -> dict:
         from .ops import pallas_kernels as PK
 
         rec["pallas"] = PK.use_pallas()
-        if args.problem == "pfsp" and args.lb == "lb2" and args.mp == 1:
-            # mp > 1 shards the pair loop and never stages. The job count
+        if args.problem == "pfsp" and args.lb == "lb2":
+            # Staging applies at every mp: under mp > 1 the compacted self
+            # bound shards its pair loop with a pmax combine. The job count
             # matters: auto mode only stages at n <= 100.
             from .ops import pfsp_device as P
             from .problems.pfsp import taillard

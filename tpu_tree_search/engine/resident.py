@@ -351,8 +351,12 @@ class _PFSPResident(_ResidentProgram):
         mp_axis = self.mp_axis
         mp_size = self.mp_size
 
+        # Staging composes with the mp pair-axis sharding: the lb1
+        # prefilter + compaction are pure shard-local ops (identical on
+        # every mp replica), and the compacted self bound shards its pair
+        # loop with a pmax combine (`lb2_self_bounds_mp`).
         staged = (
-            lb == "lb2" and mp_axis is None and self.allow_staged
+            lb == "lb2" and self.allow_staged
             and P.lb2_staged_enabled(device, n)
         )
 
@@ -375,7 +379,8 @@ class _PFSPResident(_ResidentProgram):
                 )
                 cand = open_ & (~leaf) & (bounds1 < best)
                 bounds2 = P.lb2_bounds_staged(prmu_c, limit1_c, cand, t,
-                                              device)
+                                              device, mp_axis=mp_axis,
+                                              mp_size=mp_size)
                 keep = cand & (bounds2 < best)
                 return keep, sol_inc, best
             if lb == "lb1":

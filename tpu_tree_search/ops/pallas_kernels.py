@@ -725,27 +725,26 @@ def _lb2_self_call(n: int, m: int, P: int, R: int, tile: int, interpret: bool,
     )
 
 
-def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
-                         interpret: bool = False, bf16: bool | None = None):
-    """(R,) int32 self lb2 bounds; rows >= n_active are garbage (their
-    tiles are skipped entirely). Same contract as `_lb2_self_chunk` on the
-    first n_active rows."""
-    if bf16 is None:
-        bf16 = getattr(tables, "exact_bf16", False)
+def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
+                                interpret: bool = False, bf16: bool = False):
+    """`pfsp_lb2_self_bounds` over EXPLICIT ordered tables (possibly traced
+    slices of the full pair set — the mp-sharded staged path slices each
+    shard's contiguous pair block before the call; pallas_call takes traced
+    operands like any other op). ``ordered`` needs p0_o/p1_o/lag_o (P, n),
+    tails0/tails1 (P,), msel0/msel1 (P, m), jorder (P, n, n)."""
     R, n = prmu.shape
-    m = tables.ptm_t.shape[1]
-    P = tables.pairs.shape[0]
+    m = ptm_t.shape[1]
+    P = ordered.lag_o.shape[0]
     tile = effective_tile("lb2self", n, m, P, batch=R)
     Rp = _round_up(R, tile)
     if Rp != R:
         prmu = jnp.pad(prmu, ((0, Rp - R), (0, 0)))
         limit1 = jnp.pad(limit1, ((0, Rp - R),))
-    ordered = tables.johnson_ordered()
     out = _lb2_self_call(n, m, P, Rp, tile, interpret, bf16)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
         jnp.asarray(n_active, dtype=jnp.int32).reshape(1),
-        tables.ptm_t,
+        ptm_t,
         ordered.p0_o[:, None, :],
         ordered.p1_o[:, None, :],
         ordered.lag_o[:, None, :],
@@ -756,3 +755,16 @@ def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
         ordered.jorder,
     )
     return out[:R, 0]
+
+
+def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
+                         interpret: bool = False, bf16: bool | None = None):
+    """(R,) int32 self lb2 bounds; rows >= n_active are garbage (their
+    tiles are skipped entirely). Same contract as `_lb2_self_chunk` on the
+    first n_active rows."""
+    if bf16 is None:
+        bf16 = getattr(tables, "exact_bf16", False)
+    return pfsp_lb2_self_bounds_tables(
+        prmu, limit1, n_active, tables.ptm_t, tables.johnson_ordered(),
+        interpret, bf16,
+    )

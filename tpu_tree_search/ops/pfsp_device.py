@@ -285,37 +285,57 @@ class PFSPDeviceTables:
             )
         return cache[mp_size]
 
+    def _build_ordered(self, pairs, lags, sched):
+        ptm = np.asarray(self.ptm_t).T  # (m, n)
+        P, n = sched.shape
+        rows = np.arange(P)[:, None]
+        tails = np.asarray(self.min_tails)
+        jorder = np.zeros((P, n, n), dtype=np.float32)
+        jorder[rows, np.arange(n)[None, :], sched] = 1.0
+
+        class _Ordered:
+            pass
+
+        o = _Ordered()
+        o.p0_o = jnp.asarray(ptm[pairs[:, 0][:, None], sched], dtype=jnp.int32)
+        o.p1_o = jnp.asarray(ptm[pairs[:, 1][:, None], sched], dtype=jnp.int32)
+        o.lag_o = jnp.asarray(lags[rows, sched], dtype=jnp.int32)
+        o.tails0 = jnp.asarray(tails[pairs[:, 0]], dtype=jnp.int32)
+        o.tails1 = jnp.asarray(tails[pairs[:, 1]], dtype=jnp.int32)
+        o.jorder = jnp.asarray(jorder)
+        # (P, m) one-hot machine selectors: the Pallas kernel reads row q
+        # and contracts it against the child fronts instead of dynamically
+        # slicing a VMEM value along the machine (lane) axis.
+        m = ptm.shape[0]
+        eye = np.eye(m, dtype=np.float32)
+        o.msel0 = jnp.asarray(eye[pairs[:, 0]])
+        o.msel1 = jnp.asarray(eye[pairs[:, 1]])
+        return o
+
     def johnson_ordered(self):
         if not hasattr(self, "_johnson_ordered"):
-            ptm = np.asarray(self.ptm_t).T  # (m, n)
-            pairs = np.asarray(self.pairs)  # (P, 2)
-            lags = np.asarray(self.lags)  # (P, n)
-            sched = np.asarray(self.johnson_schedules)  # (P, n) job ids
-            P, n = sched.shape
-            rows = np.arange(P)[:, None]
-            tails = np.asarray(self.min_tails)
-            jorder = np.zeros((P, n, n), dtype=np.float32)
-            jorder[rows, np.arange(n)[None, :], sched] = 1.0
-
-            class _Ordered:
-                pass
-
-            o = _Ordered()
-            o.p0_o = jnp.asarray(ptm[pairs[:, 0][:, None], sched], dtype=jnp.int32)
-            o.p1_o = jnp.asarray(ptm[pairs[:, 1][:, None], sched], dtype=jnp.int32)
-            o.lag_o = jnp.asarray(lags[rows, sched], dtype=jnp.int32)
-            o.tails0 = jnp.asarray(tails[pairs[:, 0]], dtype=jnp.int32)
-            o.tails1 = jnp.asarray(tails[pairs[:, 1]], dtype=jnp.int32)
-            o.jorder = jnp.asarray(jorder)
-            # (P, m) one-hot machine selectors: the Pallas kernel reads row q
-            # and contracts it against the child fronts instead of dynamically
-            # slicing a VMEM value along the machine (lane) axis.
-            m = ptm.shape[0]
-            eye = np.eye(m, dtype=np.float32)
-            o.msel0 = jnp.asarray(eye[pairs[:, 0]])
-            o.msel1 = jnp.asarray(eye[pairs[:, 1]])
-            self._johnson_ordered = o
+            self._johnson_ordered = self._build_ordered(
+                np.asarray(self.pairs), np.asarray(self.lags),
+                np.asarray(self.johnson_schedules),
+            )
         return self._johnson_ordered
+
+    def johnson_ordered_mp(self, mp_size: int):
+        """Ordered tables over the mp-padded pair set (P rounded up to a
+        multiple of ``mp_size`` with copies of pair 0 — max over pairs is
+        idempotent), so each mp shard can slice its contiguous P/mp block.
+        Cached per mp_size."""
+        if self.pairs.shape[0] % mp_size == 0:
+            return self.johnson_ordered()  # no padding needed: share
+        cache = getattr(self, "_johnson_ordered_mp", None)
+        if cache is None:
+            cache = self._johnson_ordered_mp = {}
+        if mp_size not in cache:
+            pairs, lags, scheds = self.mp_padded(mp_size)
+            cache[mp_size] = self._build_ordered(
+                np.asarray(pairs), np.asarray(lags), np.asarray(scheds)
+            )
+        return cache[mp_size]
 
 
 def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
@@ -448,6 +468,60 @@ def lb2_self_bounds(prmu, limit1, n_active, tables: "PFSPDeviceTables",
     )
 
 
+class _OrderedSlice:
+    """Per-shard view of the Johnson-ordered tables: each field is a traced
+    ``dynamic_slice`` of the mp-padded full table along the pair axis."""
+
+    _FIELDS = ("p0_o", "p1_o", "lag_o", "tails0", "tails1", "msel0", "msel1",
+               "jorder")
+
+    def __init__(self, full, start, P_local: int):
+        for f in self._FIELDS:
+            arr = getattr(full, f)
+            setattr(self, f, jax.lax.dynamic_slice_in_dim(
+                arr, start, P_local, axis=0
+            ))
+
+
+def lb2_self_bounds_mp(prmu, limit1, n_active, tables: "PFSPDeviceTables",
+                       mp_axis: str, mp_size: int, device=None):
+    """Self lb2 with the Johnson pair loop sharded over ``mp_axis`` (the
+    staged path's analogue of ``lb2_bounds_mp``): each shard bounds its own
+    contiguous pair block — Pallas kernel on TPU (sliced ordered tables,
+    inactive-tile skipping intact), jnp chunk elsewhere — and the shards
+    combine with ``lax.pmax``. Must be called inside shard_map with
+    ``mp_axis`` in scope. Exact: max over pairs is associative/idempotent
+    and the padding pairs are copies of pair 0."""
+    from . import pallas_kernels as PK
+
+    n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    idx = jax.lax.axis_index(mp_axis)
+    # One source of truth for the slice geometry: the padded tables' own
+    # pair axis (re-deriving the padding here could silently misalign with
+    # mp_padded's policy).
+    pairs, lags, scheds = tables.mp_padded(mp_size)
+    P_local = pairs.shape[0] // mp_size
+    start = idx * P_local
+    if (PK.use_pallas(device) and n <= 100
+            and PK.lb2_self_kernel_feasible(n, m, P_local)):
+        ordered = tables.johnson_ordered_mp(mp_size)
+        assert ordered.lag_o.shape[0] == pairs.shape[0]
+        sliced = _OrderedSlice(ordered, start, P_local)
+        local = PK.pfsp_lb2_self_bounds_tables(
+            prmu, limit1, n_active, tables.ptm_t, sliced,
+            bf16=tables.exact_bf16,
+        )
+    else:
+        prs = jax.lax.dynamic_slice_in_dim(pairs, start, P_local, axis=0)
+        lgs = jax.lax.dynamic_slice_in_dim(lags, start, P_local, axis=0)
+        sch = jax.lax.dynamic_slice_in_dim(scheds, start, P_local, axis=0)
+        local = _lb2_self_chunk(
+            prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
+            prs, lgs, sch, bf16=tables.exact_bf16,
+        )
+    return jax.lax.pmax(local, mp_axis)
+
+
 def lb2_staged_enabled(device=None, n: int | None = None) -> bool:
     """Staged lb2 (lb1 prefilter -> compacted self-lb2) pays off only where
     inactive tiles are actually skipped — the Pallas path. TTS_LB2_STAGED=1
@@ -466,7 +540,8 @@ def lb2_staged_enabled(device=None, n: int | None = None) -> bool:
 
 
 def lb2_bounds_staged(prmu, limit1, cand, tables: "PFSPDeviceTables",
-                      device=None):
+                      device=None, mp_axis: str | None = None,
+                      mp_size: int = 1):
     """lb2 child bounds evaluated ONLY for candidate children.
 
     ``cand`` (B, n) marks open, non-leaf children whose lb1 is below the
@@ -477,7 +552,13 @@ def lb2_bounds_staged(prmu, limit1, cand, tables: "PFSPDeviceTables",
     nodes (parent permutation with slots (limit1+1, k) swapped), the self
     bound runs on ceil(count/tile) active tiles, and results scatter back.
     Non-candidate slots hold garbage (never read: the caller masks with
-    ``cand``)."""
+    ``cand``).
+
+    ``mp_axis`` set (mesh dp x mp tier): the compaction is pure shard-local
+    ops — every mp replica of a dp block computes the identical candidate
+    set — and the self bound shards the pair loop over mp with a pmax
+    combine (``lb2_self_bounds_mp``), so all replicas see full-pair bounds
+    and stay in lockstep."""
     B, n = prmu.shape
     R = B * n
     flat = cand.reshape(R)
@@ -502,7 +583,11 @@ def lb2_bounds_staged(prmu, limit1, cand, tables: "PFSPDeviceTables",
     ohd = (iota == d[:, None]).astype(parent.dtype)
     ohk = (iota == k_idx[:, None]).astype(parent.dtype)
     child = parent + ohd * (vk - vd)[:, None] + ohk * (vd - vk)[:, None]
-    out = lb2_self_bounds(child, d, count, tables, device)  # (R,)
+    if mp_axis is not None:
+        out = lb2_self_bounds_mp(child, d, count, tables, mp_axis, mp_size,
+                                 device)  # (R,)
+    else:
+        out = lb2_self_bounds(child, d, count, tables, device)  # (R,)
     vals = out[jnp.where(flat, pos, 0)]
     return vals.reshape(B, n)
 

@@ -98,12 +98,13 @@ class _MeshResidentProgram:
         self.inner = _make_program(
             problem, m, M, K, capacity, mesh.devices.flat[0],
             mp_axis="mp" if self.mp > 1 else None, mp_size=self.mp,
-            # Staged lb2 runs per-shard (the compaction is pure local ops,
-            # no collectives; Pallas-inside-shard_map is already how the
-            # lb1/lb2 kernels execute in this tier). The mp>1 case keeps
-            # the single-pass evaluator: staging would have to replicate
-            # the candidate mask across the mp axis.
-            allow_staged=self.mp == 1,
+            # Staged lb2 runs per-shard in BOTH mesh modes (the compaction
+            # is pure local ops, no collectives; Pallas-inside-shard_map is
+            # already how the lb1/lb2 kernels execute in this tier). Under
+            # mp > 1 the compacted self bound shards its pair loop over mp
+            # and pmax-combines, so every replica prunes identically
+            # (`pfsp_device.lb2_self_bounds_mp`).
+            allow_staged=True,
         )
         self._build()
 
