@@ -375,6 +375,18 @@ def lb1_d_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     )
 
 
+def _lb2_pallas_enabled() -> bool:
+    """Per-family kill switch: TTS_PALLAS_LB2=0 routes ONLY the lb2-family
+    kernels (child + self) to the jnp path while the hardware-proven
+    lb1-family kernels stay on Pallas — so an lb2 compile failure costs the
+    lb2 extras, never the headline lb1 number (bench.py probes the
+    families in separate subprocesses and sets this on an lb2-only
+    failure)."""
+    import os
+
+    return os.environ.get("TTS_PALLAS_LB2", "1") != "0"
+
+
 def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     """lb2 chunk bounds, routed like ``lb1_bounds``. The Pallas kernel keeps
     the whole Johnson pair loop in VMEM — the jnp path's per-pair (B, n, n)
@@ -384,7 +396,7 @@ def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     # lb2's (P, n, n) slot-order tables cap the kernel at ~100 jobs
     # (ta031-ta090); beyond that the jnp path has the same asymptotic cost.
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
-    if (PK.use_pallas(device) and n <= 100
+    if (PK.use_pallas(device) and _lb2_pallas_enabled() and n <= 100
             and PK.lb2_kernel_feasible(n, m, tables.pairs.shape[0])):
         return PK.pfsp_lb2_bounds(prmu, limit1, tables)
     return _lb2_chunk(
@@ -458,7 +470,7 @@ def lb2_self_bounds(prmu, limit1, n_active, tables: "PFSPDeviceTables",
     from . import pallas_kernels as PK
 
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
-    if (PK.use_pallas(device) and n <= 100
+    if (PK.use_pallas(device) and _lb2_pallas_enabled() and n <= 100
             and PK.lb2_self_kernel_feasible(n, m, tables.pairs.shape[0])):
         return PK.pfsp_lb2_self_bounds(prmu, limit1, n_active, tables)
     return _lb2_self_chunk(
@@ -502,7 +514,7 @@ def lb2_self_bounds_mp(prmu, limit1, n_active, tables: "PFSPDeviceTables",
     pairs, lags, scheds = tables.mp_padded(mp_size)
     P_local = pairs.shape[0] // mp_size
     start = idx * P_local
-    if (PK.use_pallas(device) and n <= 100
+    if (PK.use_pallas(device) and _lb2_pallas_enabled() and n <= 100
             and PK.lb2_self_kernel_feasible(n, m, P_local)):
         ordered = tables.johnson_ordered_mp(mp_size)
         assert ordered.lag_o.shape[0] == pairs.shape[0]
@@ -536,7 +548,8 @@ def lb2_staged_enabled(device=None, n: int | None = None) -> bool:
         return False
     if knob == "1":
         return True
-    return PK.use_pallas(device) and (n is None or n <= 100)
+    return (PK.use_pallas(device) and _lb2_pallas_enabled()
+            and (n is None or n <= 100))
 
 
 def lb2_bounds_staged(prmu, limit1, cand, tables: "PFSPDeviceTables",
