@@ -33,5 +33,11 @@ timeout 1200 python scripts/warm_cache.py || true
 
 echo "== 5/5 tile sweep (per-kernel compile/throughput; informational) =="
 timeout 3000 python scripts/tile_sweep.py || true
+# Large-instance classes (VERDICT r4 #7): measured tile tables for ta056
+# (50x20) and ta111 (500x20); small batches + few tiles keep it bounded.
+timeout 1500 python scripts/tile_sweep.py --inst 56 --kernels lb1,lb2 \
+  --tiles 8,16,32 --batch 2048 || true
+timeout 1000 python scripts/tile_sweep.py --inst 111 --kernels lb1 \
+  --tiles 8,16 --batch 512 || true
 
 echo "Done. Update docs/HW_VALIDATION.md with the results."

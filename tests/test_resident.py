@@ -140,3 +140,43 @@ def test_lb2_staged_end_to_end_parity(monkeypatch):
         base2.explored_tree, base2.explored_sol, base2.best
     )
     assert staged2.best == opt
+
+
+def test_staged_knob_flip_rebuilds_program_same_instance(monkeypatch):
+    """Flipping TTS_LB2_STAGED between searches on the SAME problem
+    instance must rebuild the compiled program, not silently reuse the
+    stale one — the staged decision is baked in at trace time, so the
+    cache key must carry it (round-5 fix)."""
+    from tpu_tree_search.problems.pfsp import taillard
+
+    ptm = taillard.reduced_instance(14, jobs=8, machines=5)
+    prob = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    opt = sequential_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm)).best
+
+    monkeypatch.setenv("TTS_LB2_STAGED", "1")
+    r1 = resident_search(prob, m=8, M=128, K=8, initial_best=opt)
+    n_after_first = len(prob._resident_programs)
+    monkeypatch.setenv("TTS_LB2_STAGED", "0")
+    r2 = resident_search(prob, m=8, M=128, K=8, initial_best=opt)
+    assert len(prob._resident_programs) == n_after_first + 1, (
+        "knob flip reused the stale staged program"
+    )
+    assert (r1.explored_tree, r1.explored_sol, r1.best) == (
+        r2.explored_tree, r2.explored_sol, r2.best
+    )
+    # The lb2-family kill switch must also rebuild — even when staging is
+    # FORCED (=1), so the staged decision alone cannot distinguish the
+    # configs (code-review r5: the kill switch silently failing to take
+    # effect on same-instance reuse would keep a failing Pallas kernel
+    # live).
+    monkeypatch.setenv("TTS_LB2_STAGED", "1")
+    resident_search(prob, m=8, M=128, K=8, initial_best=opt)
+    n_before_kill = len(prob._resident_programs)
+    monkeypatch.setenv("TTS_PALLAS_LB2", "0")
+    r3 = resident_search(prob, m=8, M=128, K=8, initial_best=opt)
+    assert len(prob._resident_programs) == n_before_kill + 1, (
+        "TTS_PALLAS_LB2 flip reused the stale program"
+    )
+    assert (r3.explored_tree, r3.explored_sol, r3.best) == (
+        r1.explored_tree, r1.explored_sol, r1.best
+    )

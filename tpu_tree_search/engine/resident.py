@@ -452,7 +452,14 @@ def _make_program(
     cache = getattr(problem, "_resident_programs", None)
     if cache is None:
         cache = problem._resident_programs = {}
-    key = (m, M, K, capacity, id(device), mp_axis, mp_size, allow_staged)
+    # Kernel-routing decisions (Pallas vs jnp, lb2 kill switch, staging)
+    # are baked in at trace time but depend on env knobs — key them, or
+    # flipping a knob between searches on the same problem instance would
+    # silently reuse the stale program.
+    from ..ops.pfsp_device import routing_cache_token
+
+    key = (m, M, K, capacity, id(device), mp_axis, mp_size, allow_staged,
+           routing_cache_token(problem, device))
     if key in cache:
         return cache[key]
     if isinstance(problem, PFSPProblem):

@@ -552,6 +552,25 @@ def lb2_staged_enabled(device=None, n: int | None = None) -> bool:
             and (n is None or n <= 100))
 
 
+def routing_cache_token(problem, device=None) -> tuple:
+    """Every env-dependent kernel-routing decision that gets baked into a
+    compiled program at trace time (Pallas vs jnp, the lb2-family kill
+    switch, the staged-lb2 choice). Program caches keyed per problem
+    instance must carry this token so flipping TTS_PALLAS /
+    TTS_PALLAS_LB2 / TTS_LB2_STAGED between searches rebuilds instead of
+    silently reusing a stale program. One definition — used by both the
+    resident and mesh-resident cache keys."""
+    from . import pallas_kernels as PK
+
+    tok: tuple = (PK.use_pallas(device),)
+    if getattr(problem, "name", None) == "pfsp" and problem.lb == "lb2":
+        tok += (
+            _lb2_pallas_enabled(),
+            lb2_staged_enabled(device, problem.jobs),
+        )
+    return tok
+
+
 def lb2_bounds_staged(prmu, limit1, cand, tables: "PFSPDeviceTables",
                       device=None, mp_axis: str | None = None,
                       mp_size: int = 1):

@@ -436,9 +436,15 @@ def mesh_resident_search(
     cache = getattr(problem, "_mesh_programs", None)
     if cache is None:
         cache = problem._mesh_programs = {}
+    # Key the env-dependent kernel-routing decisions exactly like
+    # _make_program does (a knob flip between searches must rebuild, not
+    # reuse the stale step) — one shared token definition.
+    from ..ops.pfsp_device import routing_cache_token
+
     key = (
         tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
         m, M, K, rounds, T, capacity,
+        routing_cache_token(problem, mesh.devices.flat[0]),
     )
     program = cache.get(key)
     if program is None:
