@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # Tunnel watcher: poll the TPU backend every ~2 min; the moment it is up,
 # run the full hardware session (bench-first) so a short green window still
-# banks the round's artifact, then exit. Log everything to .tunnel_watch.log.
+# banks the round's artifact. If the session ends WITHOUT a banked bench
+# (tunnel dropped mid-run), resume watching for the next window; exit only
+# once a parity-true bench line landed. Log to .tunnel_watch.log.
 set -u
 cd "$(dirname "$0")/.."
 LOG=.tunnel_watch.log
@@ -9,10 +11,24 @@ echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[watch] TPU UP $(date -u +%FT%TZ) — running hw_session" >> "$LOG"
+    # Stale parity-true line from a previous session must not count as a
+    # banked bench for THIS run.
+    rm -f /tmp/tts_bench_line.json
     bash scripts/hw_session.sh >> .hw_session.log 2>&1
-    echo "[watch] hw_session done rc=$? $(date -u +%FT%TZ)" >> "$LOG"
-    exit 0
+    rc=$?
+    echo "[watch] hw_session done rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+    if python - <<'EOF' >/dev/null 2>&1
+import json, sys
+rec = json.load(open("/tmp/tts_bench_line.json"))
+sys.exit(0 if rec.get("parity") and rec.get("value", 0) > 0 else 1)
+EOF
+    then
+      echo "[watch] bench BANKED — exiting $(date -u +%FT%TZ)" >> "$LOG"
+      exit 0
+    fi
+    echo "[watch] bench NOT banked — resuming watch" >> "$LOG"
+  else
+    echo "[watch] down $(date -u +%FT%TZ)" >> "$LOG"
   fi
-  echo "[watch] down $(date -u +%FT%TZ)" >> "$LOG"
   sleep 120
 done

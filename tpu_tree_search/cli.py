@@ -27,11 +27,12 @@ def build_parser() -> argparse.ArgumentParser:
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument(
         "--tier",
-        choices=["seq", "device", "mesh", "multi", "dist"],
+        choices=["seq", "device", "mesh", "multi", "dist", "dist_mesh"],
         default="seq",
         help=(
             "scaling tier: sequential / single-device / SPMD device mesh / "
-            "multi-device host threads / multi-host"
+            "multi-device host threads / multi-host (offload workers) / "
+            "multi-host with per-host SPMD mesh engines (pod-scale)"
         ),
     )
     common.add_argument(
@@ -122,10 +123,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def validate_args(parser: argparse.ArgumentParser, args) -> None:
     """Reject flag combinations that would otherwise be silently ignored."""
-    if args.tier == "mesh" and args.engine == "offload":
+    if args.tier in ("mesh", "dist_mesh") and args.engine == "offload":
         parser.error(
-            "--engine offload is not available for --tier mesh "
-            "(the mesh tier is resident-only; use --tier multi for "
+            "--engine offload is not available for this tier "
+            "(mesh/dist_mesh are resident-only; use --tier multi for "
             "host-orchestrated offload across devices)"
         )
     if args.perc != 0.5 and args.tier not in ("multi", "dist"):
@@ -139,11 +140,13 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
             "taken per steal"
         )
     if (
-        args.hosts is not None or args.no_steal or args.distributed
-    ) and args.tier != "dist":
+        args.hosts is not None or args.distributed
+    ) and args.tier not in ("dist", "dist_mesh"):
         parser.error(
-            "--hosts/--no-steal/--distributed only apply to --tier dist"
+            "--hosts/--distributed only apply to --tier dist/dist_mesh"
         )
+    if args.no_steal and args.tier != "dist":
+        parser.error("--no-steal only applies to --tier dist")
     if args.distributed and args.hosts is not None:
         parser.error("--distributed (real pods) and --hosts (virtual "
                      "hosts) are mutually exclusive")
@@ -202,8 +205,21 @@ def run_tier(problem, args):
         args.max_steps is not None or args.K is not None
     ):
         raise NotImplementedError(
-            "--max-steps/--K need the device or mesh tier"
+            "--max-steps/--K need the device, mesh, or dist_mesh tier"
         )
+    if args.tier == "dist_mesh":
+        if args.checkpoint is not None or args.resume is not None:
+            raise NotImplementedError(
+                "dist_mesh has no checkpointing yet; use --tier dist for "
+                "checkpointed multi-host runs"
+            )
+        from .parallel.dist_mesh import dist_mesh_search
+
+        kw = dict(m=args.m, M=args.M, D=args.D, num_hosts=args.hosts,
+                  max_steps=args.max_steps)
+        if args.K is not None:
+            kw["K"] = args.K
+        return dist_mesh_search(problem, **kw)
     if args.tier == "seq":
         from .engine import sequential_search
 
@@ -262,6 +278,7 @@ def print_settings(args) -> None:
         "mesh": "SPMD device-mesh",
         "multi": "Multi-device",
         "dist": "Distributed multi-device",
+        "dist_mesh": "Distributed mesh-resident",
     }
     print(f"{tier_names[args.tier]} TPU tree search\n")
     if args.problem == "nqueens":
@@ -292,8 +309,12 @@ def print_results(args, problem, res) -> None:
             print(f"Elapsed time: {ph.seconds:.6f} [s]")
     if res.complete:
         print("\nExploration terminated.")
-    else:
+    elif args.checkpoint is not None:
         print("\nExploration interrupted (checkpointed; resume with --resume).")
+    else:
+        # max_steps cutoff without --checkpoint (e.g. dist_mesh, which has
+        # no checkpointing yet): no file exists — don't claim one does.
+        print("\nExploration interrupted (no checkpoint written).")
     print("\n=================================================")
     print(f"Size of the explored tree: {res.explored_tree}")
     print(f"Number of explored solutions: {res.explored_sol}")

@@ -43,6 +43,15 @@ from ..problems.base import Problem
 from .multidevice import host_pipeline
 
 
+def secondary_error(e: BaseException) -> bool:
+    """True for errors a virtual host raises only BECAUSE a peer aborted
+    the shared barrier (BrokenBarrierError inside a collective, or kv_get's
+    TimeoutError("... (peer aborted)")) — never the root cause."""
+    return isinstance(e, threading.BrokenBarrierError) or (
+        isinstance(e, TimeoutError) and "peer aborted" in str(e)
+    )
+
+
 class LocalCollectives:
     """H=1 degenerate collectives."""
 
@@ -666,15 +675,9 @@ def dist_search(
     for t in threads:
         t.join()
     # An erroring host aborts the shared barrier, so its PEERS — possibly
-    # including host 0 — die with secondary errors: BrokenBarrierError from
-    # inside a collective, or kv_get's TimeoutError("... (peer aborted)").
-    # Surface the root cause, not whichever error sits at the lowest index.
-    def _secondary(e) -> bool:
-        return isinstance(e, threading.BrokenBarrierError) or (
-            isinstance(e, TimeoutError) and "peer aborted" in str(e)
-        )
-
-    real = [e for e in errors if e is not None and not _secondary(e)]
+    # including host 0 — die with secondary errors. Surface the root cause,
+    # not whichever error sits at the lowest index.
+    real = [e for e in errors if e is not None and not secondary_error(e)]
     for e in real or errors:
         if e is not None:
             raise e

@@ -1,0 +1,377 @@
+"""Distributed mesh-resident tier: per-host SPMD engines + DCN exchange.
+
+The dist tier (`parallel/dist.py`) reproduces the reference's semantics with
+per-device *offload* workers on every host — faithful, but each chunk pays a
+host round trip. This tier is the pod-scale TPU-native composition instead:
+
+  * **inside a host**: the mesh-resident engine (`parallel/resident_mesh.py`)
+    owns all local chips with one `shard_map` program — HBM-resident pool
+    shards, `lax.while_loop` chunk cycles, `pmin` incumbent folds and
+    `ppermute` diffusion riding ICI;
+  * **between hosts**: a bulk-synchronous exchange at step boundaries over
+    the same `Collectives` interface the dist tier uses (threads for
+    testing, `jax.distributed` / DCN on a real pod): incumbent all-reduce,
+    deterministic donor->receiver matching with point-to-point node blocks
+    through the KV channel, and two-round quiescence termination.
+
+This is exactly SURVEY.md §2.5's prescription — "multi-chip = device mesh +
+ICI collectives; multi-host pod = one process per host over DCN with
+host-mediated work stealing" — with the reference's two-level hierarchy
+(`pfsp_dist_multigpu_chpl.chpl:377-379`: locales over tasks) mapped to
+hosts over mesh shards. Donations happen only when a receiver is starved
+(its mesh cannot run a chunk), so the hot path stays pure ICI; a donation
+costs the donor one frontier download + re-upload, amortized across the
+many K-cycle blocks between exchanges.
+
+Counting invariance: exchanges move nodes and tighten incumbents but never
+create/destroy nodes, so with a fixed incumbent exploredTree/exploredSol
+equal the sequential tier exactly (the same invariant every other tier
+pins in tests).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..engine.device import drain, warmup
+from ..engine.results import Diagnostics, PhaseStats, SearchResult
+from ..pool import SoAPool
+from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
+from .dist import (
+    JaxCollectives,
+    LocalCollectives,
+    ThreadCollectives,
+    secondary_error,
+)
+from .resident_mesh import _MeshResidentProgram
+
+
+def _stride_shards(batch: dict, D: int) -> list[dict]:
+    return [{k: v[w::D] for k, v in batch.items()} for w in range(D)]
+
+
+def _host_loop(
+    problem: Problem,
+    m: int,
+    M: int,
+    K: int,
+    rounds: int,
+    mesh,
+    coll,
+    initial_best: int | None,
+    seed_tag: int = 0,
+    exchange_sleep_s: float = 0.0,
+    partition_fn=None,
+    max_steps: int | None = None,
+) -> dict:
+    import jax
+
+    H = coll.num_hosts
+    me = coll.host_id
+    D = int(mesh.shape[mesh.axis_names[0]])
+    best = (
+        initial_best
+        if initial_best is not None
+        else getattr(problem, "initial_ub", INF_BOUND)
+    )
+
+    diagnostics = Diagnostics()
+    t0 = time.perf_counter()
+
+    # -- phase 1: replicate-and-slice warm-up (dist.py's scheme: identical
+    # deterministic warm-up everywhere, zero communication; host 0 owns the
+    # counters so the cross-host sum counts them once) ----------------------
+    pool = SoAPool(problem.node_fields())
+    pool.push_back(index_batch(problem.root(), 0))
+    tree1, sol1, best = warmup(problem, pool, best, H * D * m)
+    if H > 1:
+        warm = pool.as_batch()
+        pool = SoAPool(problem.node_fields())
+        if partition_fn is None:
+            pool.push_back_bulk({k: v[me::H] for k, v in warm.items()})
+        else:
+            pool.push_back_bulk(partition_fn(warm, me, H))
+        if me != 0:
+            tree1 = sol1 = 0
+    t1 = time.perf_counter()
+
+    # -- phase 2: per-host SPMD loop + step-boundary exchanges --------------
+    from ..engine.resident import resolve_capacity
+    from ..ops.pfsp_device import routing_cache_token
+
+    capacity, M = resolve_capacity(problem, M, None)
+    T = max(2 * m, min(M, 8192))
+    # Same per-problem program cache as mesh_resident_search (a recompile
+    # costs ~30s on TPU), same routing-token keying.
+    cache = getattr(problem, "_mesh_programs", None)
+    if cache is None:
+        cache = problem._mesh_programs = {}
+    key = (
+        tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
+        m, M, K, rounds, T, capacity,
+        routing_cache_token(problem, mesh.devices.flat[0]),
+    )
+    program = cache.get(key)
+    if program is None:
+        program = cache[key] = _MeshResidentProgram(
+            problem, mesh, m, M, K, rounds, T, capacity
+        )
+
+    state = program.init_state(_stride_shards(pool.as_batch(), D), best)
+    pool.clear()
+    diagnostics.host_to_device += 1
+
+    tree2 = 0
+    sol2 = 0
+    steps = 0
+    completed = True  # flipped off on a max_steps cutoff
+    quiescent_streak = 0
+    blocks_sent = blocks_received = 0
+    nodes_sent = nodes_received = 0
+    exch_rounds = 0
+    per_worker = np.zeros(D, dtype=np.int64)
+
+    def download() -> SoAPool:
+        nonlocal best
+        batch = program.full_batch(state)
+        diagnostics.device_to_host += 1
+        p = SoAPool(problem.node_fields())
+        p.push_back_bulk(batch)
+        return p
+
+    def upload(p: SoAPool):
+        nonlocal state
+        state = program.init_state(_stride_shards(p.as_batch(), D), best)
+        diagnostics.host_to_device += 1
+
+    import pickle
+
+    while True:
+        out = program.step(state)
+        state, ti, si, cy, sizes, best, tree_vec = program.read_stats(out)
+        tree2 += ti
+        sol2 += si
+        per_worker += tree_vec.astype(np.int64)
+        diagnostics.kernel_launches += cy
+        steps += 1
+        total = int(sizes.sum())
+        # Idle = this host's mesh cannot run another chunk cycle anywhere.
+        idle = int(sizes.max()) < m
+        if max_steps is not None and steps >= max_steps:
+            completed = False  # budget cutoff, not quiescence
+            break
+        if H == 1:
+            if idle:
+                break
+            continue
+        # Bulk-synchronous exchange (the dist tier's control-round shape).
+        exch_rounds += 1
+        rows = coll.allgather_obj((total, bool(idle), int(best)))
+        gbest = min(r[2] for r in rows)
+        if gbest < best:
+            # Inject the global incumbent into the sharded state: the best
+            # vector is a tiny (D,) array — replace it in place with the
+            # same sharding, no pool touch.
+            pv, pa, sz, bst = state
+            bst = jax.device_put(
+                np.minimum(np.asarray(bst), gbest).astype(np.int32),
+                program._sh_vec,
+            )
+            state = (pv, pa, sz, bst)
+            best = gbest
+        totals = [r[0] for r in rows]
+        idles = [r[1] for r in rows]
+        donors = sorted(
+            (h for h in range(H) if totals[h] >= 4 * D * m),
+            key=lambda h: (-totals[h], h),
+        )
+        needy = sorted(
+            (h for h in range(H) if idles[h]),
+            key=lambda h: (totals[h], h),
+        )
+        pairs = [(d, r) for d, r in zip(donors, needy) if d != r]
+        if all(idles) and not pairs:
+            quiescent_streak += 1
+            if quiescent_streak >= 2:
+                break
+            continue
+        quiescent_streak = 0
+        send_to = next((r for d, r in pairs if d == me), None)
+        recv_from = next((d for d, r in pairs if r == me), None)
+        if send_to is not None:
+            # Donor: download the frontier, split off the FRONT (oldest,
+            # shallowest — `Pool_par.chpl:180-191`) capped at D*M nodes,
+            # re-upload the rest. One transfer each way, only on donation
+            # rounds.
+            p = download()
+            # Steal-half-from-front policy, capped (the dist tier's bounded
+            # donation: a huge frontier never ships unbounded over DCN).
+            block = p.pop_front_bulk_half(m, 0.5, cap=D * M)
+            coll.kv_set(
+                f"tts/dmesh/{exch_rounds}/{me}->{send_to}",
+                pickle.dumps(block),
+            )
+            upload(p)
+            if block is not None:
+                blocks_sent += 1
+                nodes_sent += batch_length(block)
+        if recv_from is not None:
+            block = pickle.loads(
+                coll.kv_get(
+                    f"tts/dmesh/{exch_rounds}/{recv_from}->{me}",
+                    timeout_s=120.0,
+                )
+            )
+            if block is not None:
+                p = download()
+                p.push_back_bulk(block)
+                upload(p)
+                blocks_received += 1
+                nodes_received += batch_length(block)
+        if idle and recv_from is None and exchange_sleep_s:
+            time.sleep(exchange_sleep_s)
+
+    # -- phase 3: local residual drain --------------------------------------
+    batch = program.residual_batch(state)
+    diagnostics.device_to_host += 1
+    pool.reset_from(batch)
+    t2 = time.perf_counter()
+    tree3, sol3, best = drain(problem, pool, best)
+    t3 = time.perf_counter()
+
+    return {
+        "tree": tree1 + tree2 + tree3,
+        "sol": sol1 + sol2 + sol3,
+        "best": best,
+        "steals": blocks_received,
+        "elapsed": t3 - t0,
+        "phases": [
+            PhaseStats(t1 - t0, tree1, sol1),
+            PhaseStats(t2 - t1, tree2, sol2),
+            PhaseStats(t3 - t2, tree3, sol3),
+        ],
+        "diag": diagnostics,
+        "per_worker_tree": per_worker.tolist(),
+        "comm": {
+            "rounds": exch_rounds,
+            "blocks_sent": blocks_sent,
+            "blocks_received": blocks_received,
+            "nodes_sent": nodes_sent,
+            "nodes_received": nodes_received,
+        },
+        "complete": completed,
+    }
+
+
+def _reduce(local: dict, coll) -> SearchResult:
+    comm = {k: coll.allreduce_sum(v) for k, v in local["comm"].items()}
+    return SearchResult(
+        explored_tree=coll.allreduce_sum(local["tree"]),
+        explored_sol=coll.allreduce_sum(local["sol"]),
+        best=coll.allreduce_min(local["best"]),
+        elapsed=coll.allreduce_max(local["elapsed"]),
+        phases=local["phases"],
+        diagnostics=local["diag"],
+        per_worker_tree=local["per_worker_tree"],
+        steals=coll.allreduce_sum(local["steals"]),
+        comm=comm,
+        complete=bool(coll.allreduce_min(int(local["complete"]))),
+    )
+
+
+def dist_mesh_search(
+    problem: Problem,
+    m: int = 25,
+    M: int = 16384,
+    K: int = 16,
+    rounds: int = 2,
+    D: int | None = None,
+    num_hosts: int | None = None,
+    devices=None,
+    initial_best: int | None = None,
+    partition_fn=None,
+    max_steps: int | None = None,
+) -> SearchResult:
+    """Pod-scale search: per-host mesh-resident SPMD engines, DCN exchange.
+
+    * Under ``jax.distributed`` (process_count > 1): this process builds a
+      flat dp mesh over its local devices and exchanges with peers over the
+      coordination service.
+    * Single process with ``num_hosts=H > 1``: H virtual hosts in threads
+      over disjoint local-device groups (testing mode).
+    * ``num_hosts`` unset/1: degenerates to ``mesh_resident_search``
+      semantics (no exchange).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if jax.process_count() > 1:
+        coll = JaxCollectives()
+        local_devices = jax.local_devices() if devices is None else devices
+        if D is None:
+            D = len(local_devices)
+        mesh = Mesh(np.asarray(local_devices[:D]), ("dp",))
+        local = _host_loop(
+            problem, m, M, K, rounds, mesh, coll, initial_best,
+            partition_fn=partition_fn, max_steps=max_steps,
+        )
+        return _reduce(local, coll)
+
+    all_devices = jax.devices() if devices is None else devices
+    H = num_hosts or 1
+    if H == 1:
+        if D is None:
+            D = len(all_devices)
+        mesh = Mesh(np.asarray(all_devices[:D]), ("dp",))
+        local = _host_loop(
+            problem, m, M, K, rounds, mesh, LocalCollectives(),
+            initial_best, max_steps=max_steps,
+        )
+        return _reduce(local, LocalCollectives())
+
+    if H > len(all_devices):
+        raise ValueError(
+            f"num_hosts={H} exceeds available devices ({len(all_devices)})"
+        )
+    groups = [all_devices[h::H] for h in range(H)]
+    if D is None:
+        D = max(1, min(len(g) for g in groups))
+    coll = ThreadCollectives(H)
+    results: list = [None] * H
+    errors: list = [None] * H
+
+    def host_main(h: int):
+        try:
+            mesh = Mesh(np.asarray(groups[h][:D]), ("dp",))
+            local = _host_loop(
+                problem, m, M, K, rounds, mesh, coll.bind(h), initial_best,
+                partition_fn=partition_fn, max_steps=max_steps,
+            )
+            results[h] = _reduce(local, coll)
+        except BaseException as e:
+            errors[h] = e
+            try:
+                coll._barrier.abort()
+            except Exception:
+                pass
+
+    threads = [
+        threading.Thread(target=host_main, args=(h,), name=f"tts-dmesh-{h}")
+        for h in range(H)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    real = [e for e in errors if e is not None and not secondary_error(e)]
+    for e in real or errors:
+        if e is not None:
+            raise e
+    global_res = results[0]
+    global_res.per_worker_tree = [
+        t for r in results for t in r.per_worker_tree
+    ]
+    return global_res

@@ -290,6 +290,11 @@ class _MeshResidentProgram:
                 f"warm frontier ({F} nodes/shard) exceeds pool capacity "
                 f"{self.capacity}"
             )
+        # Bucket the staging width to a power of two (capped at capacity):
+        # ``_init`` is jitted per (D, F) shape, and callers that re-upload
+        # repeatedly (the dist_mesh donation rounds) would otherwise pay a
+        # fresh XLA compile for every distinct frontier size.
+        F = min(1 << (F - 1).bit_length(), self.capacity)
         fr_v = np.zeros((D, F) + shape_v, dtype=np.int32)
         fr_a = np.zeros((D, F), dtype=np.int32)
         for w, b in enumerate(shard_batches):
