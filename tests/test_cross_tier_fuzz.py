@@ -13,6 +13,7 @@ from tpu_tree_search.engine.device import device_search
 from tpu_tree_search.engine.resident import resident_search
 from tpu_tree_search.engine.sequential import sequential_search
 from tpu_tree_search.parallel.dist import dist_search
+from tpu_tree_search.parallel.dist_mesh import dist_mesh_search
 from tpu_tree_search.parallel.multidevice import multidevice_search
 from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
 from tpu_tree_search.problems import PFSPProblem
@@ -47,9 +48,18 @@ def _fuzz_all_tiers(seed: int, lb: str):
             steal_interval_s=0.005,
         ),
     }
+    results["dist_mesh"] = dist_mesh_search(
+        mk(), m=4, M=64, K=4, rounds=2, D=2, num_hosts=2, initial_best=opt
+    )
     if lb == "lb2":
         results["mesh_mp"] = mesh_resident_search(
             mk(), m=4, M=64, K=4, rounds=2, D=4, mp=2, initial_best=opt
+        )
+        # The full composition: staged (when forced) + mp pair sharding
+        # inside each host, host exchange between steps.
+        results["dist_mesh_mp"] = dist_mesh_search(
+            mk(), m=4, M=64, K=4, rounds=2, D=2, mp=2, num_hosts=2,
+            initial_best=opt,
         )
     for tier, res in results.items():
         assert (res.explored_tree, res.explored_sol) == golden, (

@@ -441,6 +441,15 @@ assert os.path.exists(f"{ckpt}.h{rank}"), "per-host cut missing"
 res3 = dist_search(NQueensProblem(N=10), m=5, M=256, D=2,
                    steal_interval_s=0.005, resume_from=ckpt)
 assert res3.explored_tree == 35538 and res3.explored_sol == 724
+
+# dist_mesh over the SAME real coordination service: per-process mesh
+# engines, allgather exchange + KV donations across actual processes.
+from tpu_tree_search.parallel.dist_mesh import dist_mesh_search
+res4 = dist_mesh_search(NQueensProblem(N=10), m=5, M=128, K=4, D=2,
+                        partition_fn=skew)
+assert res4.explored_tree == 35538, res4.explored_tree
+assert res4.explored_sol == 724, res4.explored_sol
+assert res4.comm is not None and res4.comm["blocks_received"] > 0
 print(f"RANK{rank}_OK donations={res.comm['blocks_received']}")
 """
 

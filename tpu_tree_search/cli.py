@@ -165,8 +165,8 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
     if args.hosts is not None and args.hosts < 1:
         parser.error("--hosts must be >= 1")
     if args.mp != 1:
-        if args.tier != "mesh":
-            parser.error("--mp only applies to --tier mesh")
+        if args.tier not in ("mesh", "dist_mesh"):
+            parser.error("--mp only applies to --tier mesh/dist_mesh")
         if args.mp < 1:
             parser.error("--mp must be >= 1")
         if args.problem != "pfsp" or args.lb != "lb2":
@@ -215,8 +215,8 @@ def run_tier(problem, args):
             )
         from .parallel.dist_mesh import dist_mesh_search
 
-        kw = dict(m=args.m, M=args.M, D=args.D, num_hosts=args.hosts,
-                  max_steps=args.max_steps)
+        kw = dict(m=args.m, M=args.M, D=args.D, mp=args.mp,
+                  num_hosts=args.hosts, max_steps=args.max_steps)
         if args.K is not None:
             kw["K"] = args.K
         return dist_mesh_search(problem, **kw)

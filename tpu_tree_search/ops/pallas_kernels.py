@@ -558,6 +558,17 @@ def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool,
     )
 
 
+def _eager_context() -> bool:
+    """True outside any jax trace — the only context where device-cached
+    table uploads are safe to build (a trace would capture tracers)."""
+    try:
+        from jax._src import core as _core
+
+        return bool(_core.trace_state_clean())
+    except Exception:  # API moved: degrade to numpy constants (correct,
+        return False   # just re-transfers on eager calls)
+
+
 def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
                     bf16: bool | None = None):
     """(B, n) int32 lb2 child bounds; same contract as `_lb2_chunk`."""
@@ -573,7 +584,11 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
         limit1 = jnp.pad(limit1, ((0, Bp - B),))
-    ordered = tables.johnson_ordered()
+    # Eager calls reuse once-uploaded device tables; traced calls bake the
+    # numpy tables as executable constants (and must NOT touch the device
+    # cache — building it under a trace would capture tracers).
+    ordered = (tables.johnson_ordered_device() if _eager_context()
+               else tables.johnson_ordered())
     out = _lb2_call(n, m, P, Bp, tile, interpret, bf16)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
@@ -764,7 +779,8 @@ def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
     first n_active rows."""
     if bf16 is None:
         bf16 = getattr(tables, "exact_bf16", False)
+    ordered = (tables.johnson_ordered_device() if _eager_context()
+               else tables.johnson_ordered())
     return pfsp_lb2_self_bounds_tables(
-        prmu, limit1, n_active, tables.ptm_t, tables.johnson_ordered(),
-        interpret, bf16,
+        prmu, limit1, n_active, tables.ptm_t, ordered, interpret, bf16,
     )

@@ -263,7 +263,14 @@ class PFSPDeviceTables:
     def mp_padded(self, mp_size: int):
         """(pairs, lags, johnson_schedules) padded to a multiple of
         ``mp_size`` with copies of pair 0 (max over pairs is idempotent, so
-        duplicates only re-max the same value). Cached per mp_size."""
+        duplicates only re-max the same value). Cached per mp_size.
+
+        The cache holds NUMPY arrays, never jnp: this method is called
+        inside shard_map traces (lb2_bounds_mp / lb2_self_bounds_mp), and
+        a jnp constant created during trace A would be cached as a tracer
+        that leaks into trace B — observed as an UnexpectedTracerError when
+        two virtual hosts build their mesh programs from one shared tables
+        object. Numpy re-lifts to a fresh constant in every trace."""
         cache = getattr(self, "_mp_padded", None)
         if cache is None:
             cache = self._mp_padded = {}
@@ -280,12 +287,13 @@ class PFSPDeviceTables:
                 scheds = np.concatenate(
                     [scheds, np.repeat(scheds[:1], reps, 0)]
                 )
-            cache[mp_size] = (
-                jnp.asarray(pairs), jnp.asarray(lags), jnp.asarray(scheds)
-            )
+            cache[mp_size] = (pairs, lags, scheds)
         return cache[mp_size]
 
     def _build_ordered(self, pairs, lags, sched):
+        # NUMPY fields only (same tracer-leak hazard as mp_padded: these
+        # builders run inside shard_map traces, and caching a trace-created
+        # jnp constant poisons every later trace).
         ptm = np.asarray(self.ptm_t).T  # (m, n)
         P, n = sched.shape
         rows = np.arange(P)[:, None]
@@ -297,19 +305,19 @@ class PFSPDeviceTables:
             pass
 
         o = _Ordered()
-        o.p0_o = jnp.asarray(ptm[pairs[:, 0][:, None], sched], dtype=jnp.int32)
-        o.p1_o = jnp.asarray(ptm[pairs[:, 1][:, None], sched], dtype=jnp.int32)
-        o.lag_o = jnp.asarray(lags[rows, sched], dtype=jnp.int32)
-        o.tails0 = jnp.asarray(tails[pairs[:, 0]], dtype=jnp.int32)
-        o.tails1 = jnp.asarray(tails[pairs[:, 1]], dtype=jnp.int32)
-        o.jorder = jnp.asarray(jorder)
+        o.p0_o = ptm[pairs[:, 0][:, None], sched].astype(np.int32)
+        o.p1_o = ptm[pairs[:, 1][:, None], sched].astype(np.int32)
+        o.lag_o = lags[rows, sched].astype(np.int32)
+        o.tails0 = tails[pairs[:, 0]].astype(np.int32)
+        o.tails1 = tails[pairs[:, 1]].astype(np.int32)
+        o.jorder = jorder
         # (P, m) one-hot machine selectors: the Pallas kernel reads row q
         # and contracts it against the child fronts instead of dynamically
         # slicing a VMEM value along the machine (lane) axis.
         m = ptm.shape[0]
         eye = np.eye(m, dtype=np.float32)
-        o.msel0 = jnp.asarray(eye[pairs[:, 0]])
-        o.msel1 = jnp.asarray(eye[pairs[:, 1]])
+        o.msel0 = eye[pairs[:, 0]]
+        o.msel1 = eye[pairs[:, 1]]
         return o
 
     def johnson_ordered(self):
@@ -319,6 +327,27 @@ class PFSPDeviceTables:
                 np.asarray(self.johnson_schedules),
             )
         return self._johnson_ordered
+
+    def johnson_ordered_device(self):
+        """Device-resident copy of the ordered tables for EAGER (un-jitted)
+        kernel calls — without it every eager lb2 evaluation would pay a
+        fresh host->device transfer of all eight arrays (the (P, n, n)
+        jorder alone is MBs). Callers must only invoke this OUTSIDE a
+        trace (`_eager_context()`), so the cache can never capture a
+        tracer; traced callers keep the numpy tables, which bake into the
+        executable as constants."""
+        if not hasattr(self, "_johnson_ordered_dev"):
+            o = self.johnson_ordered()
+
+            class _Dev:
+                pass
+
+            d = _Dev()
+            for f in ("p0_o", "p1_o", "lag_o", "tails0", "tails1",
+                      "msel0", "msel1", "jorder"):
+                setattr(d, f, jnp.asarray(getattr(o, f)))
+            self._johnson_ordered_dev = d
+        return self._johnson_ordered_dev
 
     def johnson_ordered_mp(self, mp_size: int):
         """Ordered tables over the mp-padded pair set (P rounded up to a

@@ -46,7 +46,7 @@ from .dist import (
     ThreadCollectives,
     secondary_error,
 )
-from .resident_mesh import _MeshResidentProgram
+from .resident_mesh import _MeshResidentProgram, make_dp_mp_mesh
 
 
 def _stride_shards(batch: dict, D: int) -> list[dict]:
@@ -282,6 +282,9 @@ def _reduce(local: dict, coll) -> SearchResult:
     )
 
 
+_host_mesh = make_dp_mp_mesh  # one construction policy, shared
+
+
 def dist_mesh_search(
     problem: Problem,
     m: int = 25,
@@ -289,6 +292,7 @@ def dist_mesh_search(
     K: int = 16,
     rounds: int = 2,
     D: int | None = None,
+    mp: int = 1,
     num_hosts: int | None = None,
     devices=None,
     initial_best: int | None = None,
@@ -298,24 +302,26 @@ def dist_mesh_search(
     """Pod-scale search: per-host mesh-resident SPMD engines, DCN exchange.
 
     * Under ``jax.distributed`` (process_count > 1): this process builds a
-      flat dp mesh over its local devices and exchanges with peers over the
-      coordination service.
+      dp (or dp x mp) mesh over its local devices and exchanges with peers
+      over the coordination service.
     * Single process with ``num_hosts=H > 1``: H virtual hosts in threads
       over disjoint local-device groups (testing mode).
     * ``num_hosts`` unset/1: degenerates to ``mesh_resident_search``
       semantics (no exchange).
+    * ``mp > 1`` (PFSP lb2 only): each host's mesh gains the machine-pair
+      model-parallel axis; the staged evaluator composes per shard
+      (`pfsp_device.lb2_self_bounds_mp`).
     """
     import jax
-    from jax.sharding import Mesh
 
     if jax.process_count() > 1:
         coll = JaxCollectives()
         local_devices = jax.local_devices() if devices is None else devices
         if D is None:
-            D = len(local_devices)
-        mesh = Mesh(np.asarray(local_devices[:D]), ("dp",))
+            D = max(1, len(local_devices) // mp)
         local = _host_loop(
-            problem, m, M, K, rounds, mesh, coll, initial_best,
+            problem, m, M, K, rounds, _host_mesh(local_devices, D, mp),
+            coll, initial_best,
             partition_fn=partition_fn, max_steps=max_steps,
         )
         return _reduce(local, coll)
@@ -324,11 +330,10 @@ def dist_mesh_search(
     H = num_hosts or 1
     if H == 1:
         if D is None:
-            D = len(all_devices)
-        mesh = Mesh(np.asarray(all_devices[:D]), ("dp",))
+            D = max(1, len(all_devices) // mp)
         local = _host_loop(
-            problem, m, M, K, rounds, mesh, LocalCollectives(),
-            initial_best, max_steps=max_steps,
+            problem, m, M, K, rounds, _host_mesh(all_devices, D, mp),
+            LocalCollectives(), initial_best, max_steps=max_steps,
         )
         return _reduce(local, LocalCollectives())
 
@@ -338,16 +343,16 @@ def dist_mesh_search(
         )
     groups = [all_devices[h::H] for h in range(H)]
     if D is None:
-        D = max(1, min(len(g) for g in groups))
+        D = max(1, min(len(g) for g in groups) // mp)
     coll = ThreadCollectives(H)
     results: list = [None] * H
     errors: list = [None] * H
 
     def host_main(h: int):
         try:
-            mesh = Mesh(np.asarray(groups[h][:D]), ("dp",))
             local = _host_loop(
-                problem, m, M, K, rounds, mesh, coll.bind(h), initial_best,
+                problem, m, M, K, rounds, _host_mesh(groups[h], D, mp),
+                coll.bind(h), initial_best,
                 partition_fn=partition_fn, max_steps=max_steps,
             )
             results[h] = _reduce(local, coll)

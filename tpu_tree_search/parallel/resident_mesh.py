@@ -47,6 +47,23 @@ from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, index_batch
 
 
+def make_dp_mp_mesh(devices, D: int, mp: int):
+    """The one dp / (dp, mp) mesh-construction policy (device order,
+    reshape, feasibility check) — shared by the mesh-resident and
+    dist_mesh tiers so their layouts can never drift."""
+    from jax.sharding import Mesh
+
+    if mp > 1:
+        need = D * mp
+        if len(devices) < need:
+            raise ValueError(
+                f"dp={D} x mp={mp} needs {need} devices, have "
+                f"{len(devices)}"
+            )
+        return Mesh(np.asarray(devices[:need]).reshape(D, mp), ("dp", "mp"))
+    return Mesh(np.asarray(devices[:D]), ("dp",))
+
+
 class _MeshResidentProgram:
     """Compiled SPMD step for (problem, mesh, m, M, K, rounds, T, C)."""
 
@@ -384,18 +401,7 @@ def mesh_resident_search(
             devices = jax.devices()
         if D is None:
             D = max(1, len(devices) // mp)
-        if mp > 1:
-            need = D * mp
-            if len(devices) < need:
-                raise ValueError(
-                    f"dp={D} x mp={mp} needs {need} devices, have "
-                    f"{len(devices)}"
-                )
-            mesh = Mesh(
-                np.asarray(devices[:need]).reshape(D, mp), ("dp", "mp")
-            )
-        else:
-            mesh = Mesh(np.asarray(devices[:D]), ("dp",))
+        mesh = make_dp_mp_mesh(devices, D, mp)
     D = int(mesh.shape[mesh.axis_names[0]])
     n = problem.child_slots
     from ..engine.resident import resolve_capacity
