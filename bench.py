@@ -256,6 +256,24 @@ def backend_alive(timeout_s: float = 240.0) -> tuple[bool, str | None]:
     return True, None
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def _env_override(key: str, value: str):
+    """Temporarily set an env knob; on exit restore the previous value or
+    pop the key (never clobber a user's explicit setting)."""
+    prev = os.environ.get(key)
+    os.environ[key] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+
+
 def _run_probe(code: str, ok_marker: str, timeout_s: float
                ) -> tuple[bool, str | None]:
     """One probe subprocess; returns (ok, error)."""
@@ -428,9 +446,52 @@ def main() -> int:
     record: dict = {}
     extras: list[dict] = []
     try:
-        # -- headline: PFSP ta014 lb1 --------------------------------------
         prob_hl = PFSPProblem(inst=14, lb="lb1", ub=1)
-        res, nps, elapsed, device_phase = run_config(prob_hl, m=25, M=65536)
+    except Exception as e:  # noqa: BLE001 — the line must still print
+        print(json.dumps({
+            "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
+            "value": 0.0, "unit": "nodes/sec", "vs_baseline": 0.0,
+            "parity": False, "error": f"{type(e).__name__}: {e}",
+            "pallas": pallas_ok, "extra": [],
+        }))
+        return 1
+    # Empirical headline-path selection: the probe proves the Pallas lb1
+    # kernel CORRECT, not fast — if the jnp/XLA path outruns it on this
+    # chip, the headline must use the faster one (both are exact; the
+    # metric allows any correct configuration). The kernel microbench on
+    # the search's chunk shape decides; its compiles warm the cache the
+    # chosen path reuses.
+    micro: dict = {}
+    headline_path = "jnp" if not pallas_ok else "pallas"
+    try:
+        if on_tpu and pallas_ok:
+            mb_pallas = eval_microbench(prob_hl, on_tpu)
+            with _env_override("TTS_PALLAS", "0"):
+                mb_jnp = eval_microbench(prob_hl, on_tpu)
+            micro = {"pallas": mb_pallas, "jnp": mb_jnp}
+            if (mb_jnp["bound_evals_per_sec"]
+                    > mb_pallas["bound_evals_per_sec"]):
+                headline_path = "jnp"
+            else:
+                headline_path = "pallas"
+        else:
+            micro = {"jnp" if not pallas_ok else "pallas":
+                     eval_microbench(prob_hl, on_tpu)}
+    except Exception as e:  # noqa: BLE001 — selection is best-effort
+        micro = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        # -- headline: PFSP ta014 lb1 --------------------------------------
+        # A jnp demotion is scoped to THIS run: the lb2/nqueens extras have
+        # their own kernels, which the lb1 microbench says nothing about.
+        if headline_path == "jnp" and pallas_ok:
+            with _env_override("TTS_PALLAS", "0"):
+                res, nps, elapsed, device_phase = run_config(
+                    prob_hl, m=25, M=65536
+                )
+        else:
+            res, nps, elapsed, device_phase = run_config(
+                prob_hl, m=25, M=65536
+            )
         parity = (
             res.explored_tree == GOLDEN_LB1["tree"]
             and res.explored_sol == GOLDEN_LB1["sol"]
@@ -453,13 +514,12 @@ def main() -> int:
             "roofline": roofline(nps, prob_hl.jobs, prob_hl.machines, None,
                                  "lb1"),
         }
-        try:
-            # Measured kernel-only throughput on the same chunk shape: the
-            # roofline's empirical cross-check (search MFU << kernel MFU
-            # means the gap is orchestration, not the kernel).
-            record["kernel_microbench"] = eval_microbench(prob_hl, on_tpu)
-        except Exception as e:  # noqa: BLE001 — cross-check is best-effort
-            record["kernel_microbench"] = {"error": f"{type(e).__name__}: {e}"}
+        # Measured kernel-only throughput on the same chunk shape: the
+        # roofline's empirical cross-check (search MFU << kernel MFU means
+        # the gap is orchestration, not the kernel) — and the basis of the
+        # headline-path selection above.
+        record["kernel_microbench"] = micro
+        record["headline_eval_path"] = headline_path
     except Exception as e:  # noqa: BLE001 — the line must still print
         record = {
             "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
@@ -487,20 +547,15 @@ def main() -> int:
             # already-measured primary lb2 record; the env override is
             # restored, never popped (bench must not eat a user's explicit
             # TTS_LB2_STAGED).
-            prev = os.environ.get("TTS_LB2_STAGED")
-            os.environ["TTS_LB2_STAGED"] = "0"
             try:
-                _, nps2_off, _, _ = run_config(
-                    PFSPProblem(inst=14, lb="lb2", ub=1), m=lb2_m, M=lb2_M
-                )
+                with _env_override("TTS_LB2_STAGED", "0"):
+                    _, nps2_off, _, _ = run_config(
+                        PFSPProblem(inst=14, lb="lb2", ub=1),
+                        m=lb2_m, M=lb2_M,
+                    )
                 staged_speedup = round(nps2 / max(nps2_off, 1e-9), 3)
             except Exception:  # noqa: BLE001 — comparison is best-effort
                 staged_speedup = None
-            finally:
-                if prev is None:
-                    os.environ.pop("TTS_LB2_STAGED", None)
-                else:
-                    os.environ["TTS_LB2_STAGED"] = prev
         extras.append({
             "metric": "pfsp_ta014_lb2_nodes_per_sec_per_chip",
             "value": round(nps2, 1),

@@ -282,7 +282,6 @@ def _reduce(local: dict, coll) -> SearchResult:
     )
 
 
-_host_mesh = make_dp_mp_mesh  # one construction policy, shared
 
 
 def dist_mesh_search(
@@ -320,7 +319,7 @@ def dist_mesh_search(
         if D is None:
             D = max(1, len(local_devices) // mp)
         local = _host_loop(
-            problem, m, M, K, rounds, _host_mesh(local_devices, D, mp),
+            problem, m, M, K, rounds, make_dp_mp_mesh(local_devices, D, mp),
             coll, initial_best,
             partition_fn=partition_fn, max_steps=max_steps,
         )
@@ -332,7 +331,7 @@ def dist_mesh_search(
         if D is None:
             D = max(1, len(all_devices) // mp)
         local = _host_loop(
-            problem, m, M, K, rounds, _host_mesh(all_devices, D, mp),
+            problem, m, M, K, rounds, make_dp_mp_mesh(all_devices, D, mp),
             LocalCollectives(), initial_best, max_steps=max_steps,
         )
         return _reduce(local, LocalCollectives())
@@ -351,7 +350,7 @@ def dist_mesh_search(
     def host_main(h: int):
         try:
             local = _host_loop(
-                problem, m, M, K, rounds, _host_mesh(groups[h], D, mp),
+                problem, m, M, K, rounds, make_dp_mp_mesh(groups[h], D, mp),
                 coll.bind(h), initial_best,
                 partition_fn=partition_fn, max_steps=max_steps,
             )
