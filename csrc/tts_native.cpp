@@ -279,10 +279,12 @@ void pfsp_lb1d_all_children(const PfspCtx& c, const int32_t* prmu, int limit1,
 // prunes against `incumbent` (the returned value is then still >= incumbent,
 // so the caller's prune decision is unaffected).
 int32_t pfsp_lb2_child(const PfspCtx& c, PfspScratch& s, int job,
-                       int32_t incumbent) {
+                       int32_t incumbent, bool have_front = false) {
   int32_t* cf = s.child_front.data();
-  std::memcpy(cf, s.front.data(), sizeof(int32_t) * c.m);
-  pfsp_append_job(c, cf, job);
+  if (!have_front) {  // staged caller: pfsp_lb1_child already built it
+    std::memcpy(cf, s.front.data(), sizeof(int32_t) * c.m);
+    pfsp_append_job(c, cf, job);
+  }
   s.fixed[job] = 1;
   const int32_t* pt = c.ptm.data();
   int32_t lb = 0;
@@ -333,7 +335,21 @@ int64_t pfsp_expand(const PfspCtx& c, PfspPool& pool, const int32_t* prmu,
         lb = s.lb_begin[job];
         break;
       default:
-        lb = pfsp_lb2_child(c, s, job, *best);
+        // Staged lb2 (the host analogue of the device tiers' staging and
+        // of the reference's per-pair early exit): the O(m) incremental
+        // lb1 runs first, and only survivors pay the O(P*n) Johnson pair
+        // loop. Exact — lb2 >= lb1 pointwise, so an lb1-pruned child is
+        // lb2-pruned too, and the returned (>= best) value makes the same
+        // prune decision. Leaves skip the filter: their reported value is
+        // the makespan and must come from the lb2 evaluation itself.
+        if (!child_is_leaf) {
+          lb = pfsp_lb1_child(c, s, job);
+          if (lb >= *best) break;
+          // s.child_front still holds this child's head schedule.
+          lb = pfsp_lb2_child(c, s, job, *best, /*have_front=*/true);
+        } else {
+          lb = pfsp_lb2_child(c, s, job, *best);
+        }
         break;
     }
     if (child_is_leaf) {
