@@ -119,6 +119,14 @@ inline bool nq_is_safe(const uint8_t* board, int depth, int row, int g) {
 // Expand one node onto the pool.  Returns children pushed; bumps *sol for a
 // depth==N leaf.  Child order: ascending candidate slot (parity with the
 // Python tier's j-ascending loop).
+//
+// For n <= 32 the parent's two diagonal occupancy masks are built once
+// (O(depth)) and each child checks in O(1) — bit b of diag1 marks an
+// occupied row-i+n anti-diagonal, bit b of diag2 a row+i diagonal; the
+// per-child predicate is exactly nq_is_safe's (rows are distinct by the
+// permutation invariant), so the explored tree is bit-identical. The
+// g-round workload knob repeats the masked check with the same compiler
+// barrier the scalar path uses.
 int64_t nq_expand(NqPool& pool, int n, int g, int32_t depth,
                   const uint8_t* board, int64_t* sol) {
   if (depth == n) {
@@ -126,8 +134,28 @@ int64_t nq_expand(NqPool& pool, int n, int g, int32_t depth,
     return 0;
   }
   int64_t pushed = 0;
+  uint64_t diag1 = 0, diag2 = 0;
+  const bool masks = n <= 32;
+  if (masks) {
+    for (int i = 0; i < depth; ++i) {
+      diag1 |= 1ull << (board[i] - i + n);
+      diag2 |= 1ull << (board[i] + i);
+    }
+  }
   for (int j = depth; j < n; ++j) {
-    if (!nq_is_safe(board, depth, board[j], g)) continue;
+    if (masks) {
+      const int row = board[j];
+      bool safe = true;
+      for (int round = 0; round < g; ++round) {
+        // The barrier must clobber the REGISTER inputs: a plain "memory"
+        // clobber would let LICM hoist this pure register arithmetic and
+        // turn the --g workload knob into a no-op on the fast path.
+        asm volatile("" : "+r"(diag1), "+r"(diag2));
+        safe = !(((diag1 >> (row - depth + n)) |
+                  (diag2 >> (row + depth))) & 1ull);
+      }
+      if (!safe) continue;
+    } else if (!nq_is_safe(board, depth, board[j], g)) continue;
     *pool.depth.emplace_back() = depth + 1;
     uint8_t* child = pool.board.emplace_back();
     std::memcpy(child, board, static_cast<size_t>(n));
