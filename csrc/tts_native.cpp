@@ -260,23 +260,41 @@ void pfsp_parent_state(const PfspCtx& c, const int32_t* prmu, int limit1,
   }
 }
 
-// lb1 of the child that appends `job`: one append step from the parent state,
-// then the head+remain+tail machine chain (back = min_tails, since forward
-// branching keeps limit2 == n).  Value-identical to a full recompute.
-int32_t pfsp_lb1_child(const PfspCtx& c, PfspScratch& s, int job) {
-  int32_t* cf = s.child_front.data();
-  std::memcpy(cf, s.front.data(), sizeof(int32_t) * c.m);
-  pfsp_append_job(c, cf, job);
+// lb1 of the child that appends `job`: one fused register pass over the
+// machines — the append step's running head (`cf_k = max(cf_{k-1},
+// front[k]) + pt[k][job]`), the head+remain part, and the tail chain
+// (back = min_tails, since forward branching keeps limit2 == n).
+// Value-identical to a full recompute. ONE copy of the recurrence:
+// kStoreFront additionally materializes the child front into
+// s.child_front (the staged lb2 path reuses it when the child survives
+// the prefilter); the pure-lb1 hot loop skips the stores.
+template <bool kStoreFront>
+int32_t pfsp_lb1_child_impl(const PfspCtx& c, PfspScratch& s, int job) {
   const int32_t* pt = c.ptm.data();
-  int32_t chain = cf[0] + s.remain[0] - pt[job];
+  const int32_t* front = s.front.data();
+  int32_t* cf_out = s.child_front.data();
+  int32_t cf = front[0] + pt[job];  // child head on machine 0
+  if (kStoreFront) cf_out[0] = cf;
+  int32_t chain = cf + s.remain[0] - pt[job];
   int32_t lb = chain + c.min_tails[0];
   for (int k = 1; k < c.m; ++k) {
-    const int32_t part = cf[k] + s.remain[k] - pt[k * c.n + job];
+    const int32_t fk = front[k];
+    cf = (cf > fk ? cf : fk) + pt[k * c.n + job];
+    if (kStoreFront) cf_out[k] = cf;
+    const int32_t part = cf + s.remain[k] - pt[k * c.n + job];
     if (part > chain) chain = part;
     const int32_t cand = chain + c.min_tails[k];
     if (cand > lb) lb = cand;
   }
   return lb;
+}
+
+int32_t pfsp_lb1_child(const PfspCtx& c, PfspScratch& s, int job) {
+  return pfsp_lb1_child_impl<true>(c, s, job);
+}
+
+int32_t pfsp_lb1_child_fused(const PfspCtx& c, PfspScratch& s, int job) {
+  return pfsp_lb1_child_impl<false>(c, s, job);
 }
 
 // lb1_d ("children bounds in one pass"): the weaker O(m)-per-child bound that
@@ -357,7 +375,7 @@ int64_t pfsp_expand(const PfspCtx& c, PfspPool& pool, const int32_t* prmu,
     int32_t lb;
     switch (c.lb_kind) {
       case 0:
-        lb = pfsp_lb1_child(c, s, job);
+        lb = pfsp_lb1_child_fused(c, s, job);
         break;
       case 1:
         lb = s.lb_begin[job];
