@@ -57,7 +57,7 @@ REF_C_SEQ = {
 GOLDEN_LB1 = {"tree": 2_573_652, "sol": 2648, "makespan": 1377}
 GOLDEN_LB2 = {"tree": 144_639, "sol": 0, "makespan": 1377}
 # Classical N-Queens solution counts (BASELINE.md correctness anchors).
-NQ_SOL = {12: 14_200, 15: 2_279_184}
+NQ_SOL = {12: 14_200, 14: 365_596, 15: 2_279_184}
 
 # Last successful on-chip measurement, committed so a tunnel outage degrades
 # the round's artifact to "stale number" instead of "no number" (three rounds
@@ -226,6 +226,98 @@ rs = np.asarray(P._lb2_self_chunk(
 assert np.array_equal(gs, rs), "lb2_self mismatch"
 print("PALLAS_STAGED_OK")
 """
+
+
+# Goldens are substituted from GOLDEN_LB1/GOLDEN_LB2/NQ_SOL below (one
+# source of truth; a count correction must not silently fail parity here).
+# Each workload streams its own flushed HOST_SEQ_ROW line so measurements
+# that finished before a timeout/crash still get banked.
+_HOST_SEQ = r"""
+import json, os, time
+# Unconditional CPU pin: the host-seq measurement must run during TPU
+# outages (a non-empty inherited PALLAS_AXON_POOL_IPS would hang jax
+# backend init — the whole point is to bank numbers when that happens).
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+from tpu_tree_search.engine import sequential_search
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+for tag, mk, tree, sol, best in (
+    ("pfsp_ta014_lb1", lambda: PFSPProblem(inst=14, lb="lb1", ub=1),
+     @LB1_TREE@, @LB1_SOL@, @LB1_MS@),
+    ("pfsp_ta014_lb2", lambda: PFSPProblem(inst=14, lb="lb2", ub=1),
+     @LB2_TREE@, @LB2_SOL@, @LB2_MS@),
+    ("nqueens_n14", lambda: NQueensProblem(N=14), 27358552, @NQ14_SOL@,
+     None),
+):
+    bnps = None
+    parity = True
+    for _ in range(2):
+        t0 = time.time()
+        r = sequential_search(mk())
+        dt = time.time() - t0
+        parity &= (r.explored_tree, r.explored_sol) == (tree, sol)
+        if best is not None:
+            parity &= r.best == best
+        nps = r.explored_tree / max(dt, 1e-9)
+        bnps = nps if bnps is None else max(bnps, nps)
+    print("HOST_SEQ_ROW " + json.dumps(
+        {"tag": tag, "nodes_per_sec": round(bnps, 1), "parity": parity}
+    ), flush=True)
+""".replace("@LB1_TREE@", str(GOLDEN_LB1["tree"])) \
+   .replace("@LB1_SOL@", str(GOLDEN_LB1["sol"])) \
+   .replace("@LB1_MS@", str(GOLDEN_LB1["makespan"])) \
+   .replace("@LB2_TREE@", str(GOLDEN_LB2["tree"])) \
+   .replace("@LB2_SOL@", str(GOLDEN_LB2["sol"])) \
+   .replace("@LB2_MS@", str(GOLDEN_LB2["makespan"])) \
+   .replace("@NQ14_SOL@", str(NQ_SOL[14]))
+
+
+def host_seq_extras(timeout_s: float = 180.0) -> list[dict]:
+    """Measured host-runtime (C++ sequential tier) records with ratios
+    against the reference C programs (BASELINE.md) — these need no TPU, so
+    even an outage round's artifact carries real numbers. Subprocess +
+    timeout; NEVER raises: a native-runtime crash, a timeout, or garbled
+    output must cost only this block, not the bench's JSON line (rows
+    already streamed before the failure are kept)."""
+    try:
+        err = None
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", _HOST_SEQ],
+                timeout=timeout_s, capture_output=True, text=True,
+            )
+            out = res.stdout or ""
+            if res.returncode != 0:
+                tail = (res.stderr or out).strip().splitlines()[-2:]
+                err = "host_seq child rc={}: {}".format(
+                    res.returncode, " | ".join(tail))
+        except subprocess.TimeoutExpired as e:
+            raw = e.stdout
+            out = (raw.decode(errors="replace")
+                   if isinstance(raw, bytes) else raw) or ""
+            err = f"timed out after {timeout_s:.0f}s"
+        extras = []
+        for ln in out.splitlines():
+            if not ln.startswith("HOST_SEQ_ROW "):
+                continue
+            try:
+                r = json.loads(ln[len("HOST_SEQ_ROW "):])
+                extras.append({
+                    "metric": f"host_seq_{r['tag']}_nodes_per_sec",
+                    "value": r["nodes_per_sec"],
+                    "vs_ref_c_seq": round(
+                        r["nodes_per_sec"] / REF_C_SEQ[r["tag"]], 3
+                    ) if r["tag"] in REF_C_SEQ else None,
+                    "parity": r["parity"],
+                })
+            except (ValueError, KeyError):
+                continue  # torn line from a mid-write kill
+        if err is not None:
+            extras.append({"metric": "host_seq", "error": err})
+        return extras
+    except Exception as e:  # noqa: BLE001 — the bench line must survive
+        return [{"metric": "host_seq",
+                 "error": f"{type(e).__name__}: {e}"}]
 
 
 def backend_alive(timeout_s: float = 240.0) -> tuple[bool, str | None]:
@@ -415,7 +507,9 @@ def main() -> int:
             "parity": False,
             "error": alive_err,
             "pallas": False,
-            "extra": [],
+            # The TPU is unreachable, but the host-runtime comparison needs
+            # no TPU — an outage round still banks measured numbers.
+            "extra": host_seq_extras(),
         }
         if (lg := last_good()) is not None:
             err_record["last_good"] = lg
@@ -597,6 +691,7 @@ def main() -> int:
             "error": f"{type(e).__name__}: {e}",
         })
 
+    extras.extend(host_seq_extras())
     record["pallas"] = pallas_ok
     if pallas_err:
         record["pallas_error"] = pallas_err
