@@ -139,12 +139,20 @@ def _lb2_static_extra(n: int, m: int, P: int) -> int:
 
 
 # The single source of truth for each kernel's VMEM-model parameters:
-# (tile env knob, measured tile default, tn2_copies, needs per-pair extra).
+# (tile env knob, tile default, tn2_copies, needs per-pair extra).
+# Tile defaults: lb1 64 and lb1d 256 are MEASURED on the real v5e
+# (docs/HW_VALIDATION.md; lb1 at 128 compiled >270s — Mosaic compile time
+# grows superlinearly with tile). The lb2 family is not hardware-measured
+# yet, and it is a strictly bigger kernel (190-pair fori_loop, per-pair
+# tables), so its defaults start in the compile-time-safe class lb1 proved
+# (64): a first-window probe that compiles beats a faster tile that times
+# out. scripts/tile_sweep.py re-measures per (kernel, tile) so the
+# defaults can be raised with data.
 _KERNEL_MODEL = {
     "lb1": ("TTS_TILE_LB1", 64, 3, False),
     "lb1d": ("TTS_TILE_LB1D", 256, 3, False),
-    "lb2": ("TTS_TILE_LB2", 128, 8, True),
-    "lb2self": ("TTS_TILE_LB2SELF", 256, 6, True),
+    "lb2": ("TTS_TILE_LB2", 64, 8, True),
+    "lb2self": ("TTS_TILE_LB2SELF", 64, 6, True),
 }
 
 
