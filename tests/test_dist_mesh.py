@@ -84,3 +84,73 @@ def test_max_steps_budget_reports_incomplete():
     )
     assert not res.complete
     assert res.explored_tree > 0
+
+
+def test_checkpoint_resume_lockstep_cuts(tmp_path):
+    """Per-host lockstep cuts at exchange boundaries: interval 0 cuts every
+    round; both files must carry the SAME "<uuid>:<round>" tag and format
+    v3, resume must land exactly on the sequential goldens, and a tampered
+    tag must be refused (the dist tier's coherence contract)."""
+    import json
+
+    from tpu_tree_search.engine import checkpoint as ckpt
+
+    path = str(tmp_path / "dm.ckpt")
+    prob = NQueensProblem(N=10)
+    seq = sequential_search(prob)
+    full = dist_mesh_search(
+        prob, m=5, M=128, K=2, rounds=1, D=2, num_hosts=2,
+        checkpoint_path=path, checkpoint_interval_s=0.0,
+    )
+    assert (full.explored_tree, full.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    tags = []
+    for h in (0, 1):
+        with np.load(path + f".h{h}") as data:
+            header = json.loads(bytes(data["header"]).decode())
+        assert header["version"] == 3 and header["hosts"] == 2
+        tags.append(header["cut_tag"])
+    assert tags[0] == tags[1] and ":" in str(tags[0])
+
+    resumed = dist_mesh_search(
+        NQueensProblem(N=10), m=5, M=128, K=2, rounds=1, D=2, num_hosts=2,
+        resume_from=path,
+    )
+    assert (resumed.explored_tree, resumed.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+
+    loaded = ckpt.load(path + ".h1", NQueensProblem(N=10), expect_hosts=2)
+    ckpt.save(path + ".h1", prob, loaded.batch, loaded.best, loaded.tree,
+              loaded.sol, hosts=2, cut_tag="deadbeef0000:999")
+    with pytest.raises(ValueError, match="incoherent multi-host resume"):
+        dist_mesh_search(
+            NQueensProblem(N=10), m=5, M=128, K=2, rounds=1, D=2,
+            num_hosts=2, resume_from=path,
+        )
+
+
+def test_budget_cutoff_cut_then_resume_to_goldens(tmp_path):
+    """A max_steps cutoff with --checkpoint writes one final lockstep cut;
+    resuming without the budget completes to the exact sequential
+    goldens (counters continue across the cut)."""
+    path = str(tmp_path / "dmcut.ckpt")
+    prob = NQueensProblem(N=11)
+    seq = sequential_search(prob)
+    part = dist_mesh_search(
+        prob, m=5, M=64, K=1, rounds=1, D=2, num_hosts=2,
+        max_steps=2, checkpoint_path=path,
+    )
+    assert not part.complete
+    import os
+
+    assert os.path.exists(path + ".h0") and os.path.exists(path + ".h1")
+    resumed = dist_mesh_search(
+        NQueensProblem(N=11), m=5, M=64, K=2, rounds=1, D=2, num_hosts=2,
+        resume_from=path,
+    )
+    assert (resumed.explored_tree, resumed.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    assert resumed.complete

@@ -208,15 +208,10 @@ def run_tier(problem, args):
             "--max-steps/--K need the device, mesh, or dist_mesh tier"
         )
     if args.tier == "dist_mesh":
-        if args.checkpoint is not None or args.resume is not None:
-            raise NotImplementedError(
-                "dist_mesh has no checkpointing yet; use --tier dist for "
-                "checkpointed multi-host runs"
-            )
         from .parallel.dist_mesh import dist_mesh_search
 
         kw = dict(m=args.m, M=args.M, D=args.D, mp=args.mp,
-                  num_hosts=args.hosts, max_steps=args.max_steps)
+                  num_hosts=args.hosts, **ckpt_kw)
         if args.K is not None:
             kw["K"] = args.K
         return dist_mesh_search(problem, **kw)
@@ -312,8 +307,8 @@ def print_results(args, problem, res) -> None:
     elif args.checkpoint is not None:
         print("\nExploration interrupted (checkpointed; resume with --resume).")
     else:
-        # max_steps cutoff without --checkpoint (e.g. dist_mesh, which has
-        # no checkpointing yet): no file exists — don't claim one does.
+        # max_steps cutoff without --checkpoint: no file exists — don't
+        # claim one does.
         print("\nExploration interrupted (no checkpoint written).")
     print("\n=================================================")
     print(f"Size of the explored tree: {res.explored_tree}")

@@ -74,6 +74,31 @@ class RunController:
         return False
 
 
+def lockstep_commit(ok: bool, staging: str, final: str, vote=None) -> bool:
+    """Two-phase commit of a staged per-host checkpoint file — the ONE
+    copy of the protocol shared by the dist and dist_mesh tiers: optionally
+    vote across hosts (``vote(bool) -> list[bool]``, an allgather), commit
+    the rename only if EVERY host staged successfully, otherwise discard
+    the staging file so the set stays on the previous coherent cut. A
+    vetoed/failed cut warns on stderr — silently keeping a stale file
+    while the CLI tells the user to resume would lose budgeted work."""
+    import sys
+
+    if vote is not None:
+        ok = all(vote(bool(ok)))
+    if ok:
+        os.replace(staging, final)
+    else:
+        if os.path.exists(staging):
+            os.remove(staging)
+        print(
+            f"[checkpoint] lockstep cut NOT committed ({final}); the "
+            "previous coherent cut (if any) is retained",
+            file=sys.stderr,
+        )
+    return ok
+
+
 @dataclass
 class Checkpoint:
     meta: dict  # problem identity, see problem_meta()

@@ -479,15 +479,14 @@ class _HostComm:
                 # rounds: they would be double-explored or lost on resume).
                 import os as _os
 
+                from ..engine.checkpoint import lockstep_commit
+
                 staging = self.ckpt_mgr.path + ".staging"
                 ok = self.ckpt_mgr.do_checkpoint(
                     to_path=staging, cut_tag=rows[0][5]
                 )
-                oks = coll.allgather_obj(bool(ok))
-                if all(oks):
-                    _os.replace(staging, self.ckpt_mgr.path)
-                elif _os.path.exists(staging):
-                    _os.remove(staging)
+                lockstep_commit(ok, staging, self.ckpt_mgr.path,
+                                vote=coll.allgather_obj)
                 self._ckpt_last = _time.monotonic()
 
 

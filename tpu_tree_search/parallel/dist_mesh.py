@@ -66,8 +66,13 @@ def _host_loop(
     exchange_sleep_s: float = 0.0,
     partition_fn=None,
     max_steps: int | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 60.0,
+    resume_from: str | None = None,
 ) -> dict:
     import jax
+
+    from ..engine import checkpoint as ckpt_mod
 
     H = coll.num_hosts
     me = coll.host_id
@@ -77,25 +82,45 @@ def _host_loop(
         if initial_best is not None
         else getattr(problem, "initial_ub", INF_BOUND)
     )
+    suffix = f".h{me}" if H > 1 else ""
+    eff_ckpt = None if checkpoint_path is None else checkpoint_path + suffix
+    eff_resume = None if resume_from is None else resume_from + suffix
 
     diagnostics = Diagnostics()
     t0 = time.perf_counter()
 
     # -- phase 1: replicate-and-slice warm-up (dist.py's scheme: identical
     # deterministic warm-up everywhere, zero communication; host 0 owns the
-    # counters so the cross-host sum counts them once) ----------------------
+    # counters so the cross-host sum counts them once) — or restore --------
     pool = SoAPool(problem.node_fields())
-    pool.push_back(index_batch(problem.root(), 0))
-    tree1, sol1, best = warmup(problem, pool, best, H * D * m)
-    if H > 1:
-        warm = pool.as_batch()
-        pool = SoAPool(problem.node_fields())
-        if partition_fn is None:
-            pool.push_back_bulk({k: v[me::H] for k, v in warm.items()})
-        else:
-            pool.push_back_bulk(partition_fn(warm, me, H))
-        if me != 0:
-            tree1 = sol1 = 0
+    if eff_resume is not None:
+        loaded = ckpt_mod.load(eff_resume, problem, expect_hosts=H)
+        if H > 1:
+            # Lockstep-cut coherence across the per-host files (same check
+            # as the dist tier's resume, multidevice.py): mixed cuts would
+            # lose or double-explore nodes donated between rounds.
+            tags = coll.allgather_obj(loaded.cut_tag)
+            if len(set(tags)) != 1:
+                raise ValueError(
+                    "incoherent multi-host resume: per-host checkpoint "
+                    f"files come from different cuts ({tags}); restore a "
+                    "matching set before resuming"
+                )
+        pool.push_back_bulk(loaded.batch)
+        tree1, sol1 = loaded.tree, loaded.sol
+        best = min(best, loaded.best)
+    else:
+        pool.push_back(index_batch(problem.root(), 0))
+        tree1, sol1, best = warmup(problem, pool, best, H * D * m)
+        if H > 1:
+            warm = pool.as_batch()
+            pool = SoAPool(problem.node_fields())
+            if partition_fn is None:
+                pool.push_back_bulk({k: v[me::H] for k, v in warm.items()})
+            else:
+                pool.push_back_bulk(partition_fn(warm, me, H))
+            if me != 0:
+                tree1 = sol1 = 0
     t1 = time.perf_counter()
 
     # -- phase 2: per-host SPMD loop + step-boundary exchanges --------------
@@ -148,6 +173,31 @@ def _host_loop(
         diagnostics.host_to_device += 1
 
     import pickle
+    import uuid as _uuid
+
+    # Checkpointing: lockstep cuts at exchange boundaries. The cut point —
+    # right after a round's allgather, before its donations — is provably
+    # donation-coherent: the allgather is a barrier, so every prior round's
+    # blocks are integrated on both ends and none are in flight. Host 0
+    # proposes "<uuid>:<round>" in the control tuple; every host stamps
+    # that exact tag (resume verifies coherence collectively).
+    run_uuid = _uuid.uuid4().hex[:12]
+    ckpt_last = time.monotonic()
+
+    def do_lockstep_cut(tag) -> None:
+        staging = eff_ckpt + ".staging"
+        ok = True
+        try:
+            batch = program.full_batch(state)
+            diagnostics.device_to_host += 1
+            ckpt_mod.save(staging, problem, batch, best,
+                          tree1 + tree2, sol1 + sol2, hosts=H, cut_tag=tag)
+        except Exception:  # noqa: BLE001 — a failed host must veto commit
+            ok = False
+        ckpt_mod.lockstep_commit(
+            ok, staging, eff_ckpt,
+            vote=coll.allgather_obj if H > 1 else None,
+        )
 
     while True:
         out = program.step(state)
@@ -162,14 +212,34 @@ def _host_loop(
         idle = int(sizes.max()) < m
         if max_steps is not None and steps >= max_steps:
             completed = False  # budget cutoff, not quiescence
+            if eff_ckpt is not None:
+                # Final lockstep cut so the budgeted run is resumable; all
+                # hosts reach this point in the same iteration, and host
+                # 0's tag rides a dedicated allgather.
+                tag = f"{run_uuid}:cutoff{steps}"
+                if H > 1:
+                    tag = coll.allgather_obj(tag)[0]
+                do_lockstep_cut(tag)
             break
         if H == 1:
+            if (eff_ckpt is not None
+                    and time.monotonic() - ckpt_last
+                    >= checkpoint_interval_s):
+                do_lockstep_cut(f"{run_uuid}:{steps}")
+                ckpt_last = time.monotonic()
             if idle:
                 break
             continue
         # Bulk-synchronous exchange (the dist tier's control-round shape).
         exch_rounds += 1
-        rows = coll.allgather_obj((total, bool(idle), int(best)))
+        want_ckpt = (
+            eff_ckpt is not None and me == 0
+            and time.monotonic() - ckpt_last >= checkpoint_interval_s
+        )
+        cut_id = f"{run_uuid}:{exch_rounds}" if want_ckpt else None
+        rows = coll.allgather_obj(
+            (total, bool(idle), int(best), want_ckpt, cut_id)
+        )
         gbest = min(r[2] for r in rows)
         if gbest < best:
             # Inject the global incumbent into the sharded state: the best
@@ -182,6 +252,11 @@ def _host_loop(
             )
             state = (pv, pa, sz, bst)
             best = gbest
+        if eff_ckpt is not None and rows[0][3]:
+            # Cut point: after incumbent adoption (the snapshot carries the
+            # tightened best), before this round's donations.
+            do_lockstep_cut(rows[0][4])
+            ckpt_last = time.monotonic()
         totals = [r[0] for r in rows]
         idles = [r[1] for r in rows]
         donors = sorted(
@@ -297,6 +372,9 @@ def dist_mesh_search(
     initial_best: int | None = None,
     partition_fn=None,
     max_steps: int | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 60.0,
+    resume_from: str | None = None,
 ) -> SearchResult:
     """Pod-scale search: per-host mesh-resident SPMD engines, DCN exchange.
 
@@ -322,6 +400,9 @@ def dist_mesh_search(
             problem, m, M, K, rounds, make_dp_mp_mesh(local_devices, D, mp),
             coll, initial_best,
             partition_fn=partition_fn, max_steps=max_steps,
+            checkpoint_path=checkpoint_path,
+            checkpoint_interval_s=checkpoint_interval_s,
+            resume_from=resume_from,
         )
         return _reduce(local, coll)
 
@@ -333,6 +414,9 @@ def dist_mesh_search(
         local = _host_loop(
             problem, m, M, K, rounds, make_dp_mp_mesh(all_devices, D, mp),
             LocalCollectives(), initial_best, max_steps=max_steps,
+            checkpoint_path=checkpoint_path,
+            checkpoint_interval_s=checkpoint_interval_s,
+            resume_from=resume_from,
         )
         return _reduce(local, LocalCollectives())
 
@@ -353,6 +437,9 @@ def dist_mesh_search(
                 problem, m, M, K, rounds, make_dp_mp_mesh(groups[h], D, mp),
                 coll.bind(h), initial_best,
                 partition_fn=partition_fn, max_steps=max_steps,
+                checkpoint_path=checkpoint_path,
+                checkpoint_interval_s=checkpoint_interval_s,
+                resume_from=resume_from,
             )
             results[h] = _reduce(local, coll)
         except BaseException as e:
