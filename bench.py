@@ -497,7 +497,14 @@ def main() -> int:
 
     enable_compile_cache()
 
-    alive, alive_err = backend_alive()
+    # TTS_BENCH_EXPRESS=1: bank a first on-chip number in the smallest
+    # possible window — short liveness, no kernel probes (jnp path, proven
+    # on-chip in round 2), headline config only. The hardware session runs
+    # this before the full bench so a tunnel that stays up five minutes
+    # still produces the round's artifact; a completed full bench then
+    # overwrites BENCH_LAST_GOOD.json with the better-configured number.
+    express = os.environ.get("TTS_BENCH_EXPRESS", "0") == "1"
+    alive, alive_err = backend_alive(120.0 if express else 240.0)
     if not alive:
         err_record = {
             "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
@@ -509,15 +516,22 @@ def main() -> int:
             "pallas": False,
             # The TPU is unreachable, but the host-runtime comparison needs
             # no TPU — an outage round still banks measured numbers.
-            "extra": host_seq_extras(),
+            # (Express mode skips it: the full bench follows right behind.)
+            "extra": [] if express else host_seq_extras(),
         }
         if (lg := last_good()) is not None:
             err_record["last_good"] = lg
         print(json.dumps(err_record))
         return 1
 
-    (pallas_ok, pallas_err, lb2_ok, lb2_err,
-     staged_ok, staged_err) = probe_pallas()
+    if express:
+        os.environ["TTS_PALLAS"] = "0"
+        pallas_ok = lb2_ok = staged_ok = False
+        pallas_err = "express mode: probes skipped (jnp path)"
+        lb2_err = staged_err = None
+    else:
+        (pallas_ok, pallas_err, lb2_ok, lb2_err,
+         staged_ok, staged_err) = probe_pallas()
     if not pallas_ok:
         os.environ["TTS_PALLAS"] = "0"
     if pallas_ok and not lb2_ok:
@@ -558,7 +572,9 @@ def main() -> int:
     micro: dict = {}
     headline_path = "jnp" if not pallas_ok else "pallas"
     try:
-        if on_tpu and pallas_ok:
+        if express:
+            pass  # no microbench: every compile second counts
+        elif on_tpu and pallas_ok:
             mb_pallas = eval_microbench(prob_hl, on_tpu)
             with _env_override("TTS_PALLAS", "0"):
                 mb_jnp = eval_microbench(prob_hl, on_tpu)
@@ -624,7 +640,33 @@ def main() -> int:
             "error": f"{type(e).__name__}: {e}",
         }
 
-    # -- extras: ta014 lb2 + N-Queens N=15 (never fail the bench) ----------
+    # -- extras: ta014 lb2 + N-Queens N=15 (never fail the bench; express
+    # mode skips them all and shares the finalization tail below) ----------
+    if not express:
+        _collect_extras(extras, on_tpu, staged_ok, staged_err)
+    if express:
+        record["express"] = True
+    record["backend"] = jax.default_backend()
+    record["pallas"] = pallas_ok
+    if pallas_err:
+        record["pallas_error"] = pallas_err
+    record["pallas_lb2"] = lb2_ok
+    if lb2_err:
+        record["pallas_lb2_error"] = lb2_err
+    record["extra"] = extras
+    if on_tpu and record.get("parity") and record.get("value", 0) > 0:
+        record_last_good(record)
+    print(json.dumps(record))
+    return 0 if record.get("parity") else 1
+
+
+def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
+                    staged_err: str | None) -> None:
+    """The full bench's extra records (ta014 lb2 + staged comparison,
+    N-Queens, host-seq) — split out so the express path shares main()'s
+    single finalization tail instead of duplicating it."""
+    from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+
     try:
         # CPU smoke: small chunks — the jnp lb2's per-pair (B, n, n)
         # intermediates make huge chunks crawl without the TPU's bandwidth.
@@ -692,17 +734,6 @@ def main() -> int:
         })
 
     extras.extend(host_seq_extras())
-    record["pallas"] = pallas_ok
-    if pallas_err:
-        record["pallas_error"] = pallas_err
-    record["pallas_lb2"] = lb2_ok
-    if lb2_err:
-        record["pallas_lb2_error"] = lb2_err
-    record["extra"] = extras
-    if on_tpu and record.get("parity") and record.get("value", 0) > 0:
-        record_last_good(record)
-    print(json.dumps(record))
-    return 0 if record.get("parity") else 1
 
 
 if __name__ == "__main__":

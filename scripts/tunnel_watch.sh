@@ -11,16 +11,27 @@ echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[watch] TPU UP $(date -u +%FT%TZ) — running hw_session" >> "$LOG"
-    # Stale parity-true line from a previous session must not count as a
+    # Stale parity-true lines from a previous session must not count as a
     # banked bench for THIS run.
-    rm -f /tmp/tts_bench_line.json
+    rm -f /tmp/tts_bench_line.json /tmp/tts_bench_express.json
     bash scripts/hw_session.sh >> .hw_session.log 2>&1
     rc=$?
     echo "[watch] hw_session done rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
     if python - <<'EOF' >/dev/null 2>&1
 import json, sys
-rec = json.load(open("/tmp/tts_bench_line.json"))
-sys.exit(0 if rec.get("parity") and rec.get("value", 0) > 0 else 1)
+for path in ("/tmp/tts_bench_line.json", "/tmp/tts_bench_express.json"):
+    try:
+        rec = json.load(open(path))
+        # backend must be "tpu": an exported JAX_PLATFORMS=cpu (the outage
+        # workaround) passes the liveness probe and yields parity-true CPU
+        # records, which must NOT stop the watch (mirrors bench.py's
+        # on_tpu banking guard).
+        if (rec.get("backend") == "tpu" and rec.get("parity")
+                and rec.get("value", 0) > 0):
+            sys.exit(0)
+    except Exception:
+        pass
+sys.exit(1)
 EOF
     then
       echo "[watch] bench BANKED — exiting $(date -u +%FT%TZ)" >> "$LOG"
