@@ -548,7 +548,7 @@ def main() -> int:
 
     import jax
 
-    from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+    from tpu_tree_search.problems import PFSPProblem
 
     on_tpu = jax.default_backend() == "tpu"
     record: dict = {}

@@ -1,0 +1,138 @@
+"""Unit tests for the bench harness's pure logic — the round's artifact
+generator must not be the one untested component. Everything here runs in
+milliseconds-to-seconds on CPU; the full end-to-end line is exercised by
+running `python bench.py` (hardware sessions / CI smoke)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bench
+
+
+def test_roofline_math_lb1():
+    rl = bench.roofline(1_000_000.0, 20, 10, None, "lb1")
+    # flops/parent = 4*n^2*m + 6*n*m = 16000 + 1200
+    assert rl["flops_per_parent"] == 17_200
+    assert rl["bound_evals_per_sec"] == 20_000_000.0
+    assert rl["achieved_gflops"] == round(1e6 * 17_200 / 1e9, 2)
+    assert 0 < rl["mfu_pct"] < 100
+
+
+def test_roofline_math_lb2_includes_pairs():
+    rl1 = bench.roofline(1000.0, 20, 10, 45, "lb2")
+    rl2 = bench.roofline(1000.0, 20, 10, 90, "lb2")
+    assert rl2["flops_per_parent"] > rl1["flops_per_parent"]
+
+
+def test_env_override_restores_and_pops(monkeypatch):
+    monkeypatch.delenv("TTS_X_TEST", raising=False)
+    with bench._env_override("TTS_X_TEST", "1"):
+        assert os.environ["TTS_X_TEST"] == "1"
+    assert "TTS_X_TEST" not in os.environ  # popped, not set to ""
+
+    monkeypatch.setenv("TTS_X_TEST", "keep")
+    with pytest.raises(RuntimeError):
+        with bench._env_override("TTS_X_TEST", "1"):
+            raise RuntimeError("boom")
+    assert os.environ["TTS_X_TEST"] == "keep"  # restored on exception
+
+
+def test_probe_pallas_honors_kill_switches(monkeypatch):
+    monkeypatch.setenv("TTS_PALLAS", "0")
+    ok1, err1, ok2, err2, ok3, err3 = bench.probe_pallas(timeout_s=5)
+    assert not ok1 and "TTS_PALLAS=0" in err1
+
+    monkeypatch.setenv("TTS_PALLAS", "1")
+    monkeypatch.setenv("TTS_PALLAS_LB2", "0")
+    # lb1 probe subprocess runs (and reports non-tpu backend on CPU).
+    ok1, err1, ok2, err2, ok3, err3 = bench.probe_pallas(timeout_s=120)
+    assert not ok1 and "not tpu" in err1
+
+
+def test_record_last_good_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "lg.json"))
+    rec = {"metric": "m", "value": 123.0, "vs_baseline": 1.0,
+           "vs_ref_c_seq": 0.5, "pallas": True}
+    bench.record_last_good(rec)
+    lg = bench.last_good()
+    assert lg["value"] == 123.0 and lg["vs_ref_c_seq"] == 0.5
+    assert lg["pallas"] is True and "commit" in lg and "date" in lg
+
+
+def test_host_seq_parses_partial_rows(monkeypatch):
+    """A timeout must keep the rows that already streamed (round-5
+    contract: finished measurements survive)."""
+
+    def fake_run(*a, **kw):
+        raise subprocess.TimeoutExpired(
+            cmd="x", timeout=1.0,
+            output=(
+                'HOST_SEQ_ROW {"tag": "pfsp_ta014_lb1", '
+                '"nodes_per_sec": 1000.0, "parity": true}\n'
+                "HOST_SEQ_ROW {torn"
+            ).encode(),
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    rows = bench.host_seq_extras(timeout_s=1.0)
+    metrics = [r["metric"] for r in rows]
+    assert "host_seq_pfsp_ta014_lb1_nodes_per_sec" in metrics
+    assert rows[0]["vs_ref_c_seq"] == round(
+        1000.0 / bench.REF_C_SEQ["pfsp_ta014_lb1"], 3
+    )
+    assert any("error" in r for r in rows)  # the timeout is still recorded
+
+
+def test_host_seq_never_raises(monkeypatch):
+    def fake_run(*a, **kw):
+        raise OSError("no such executable")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    rows = bench.host_seq_extras(timeout_s=1.0)
+    assert rows and "error" in rows[0]
+
+
+def test_host_seq_goldens_come_from_constants():
+    """The child script's parity goldens must be substituted from the
+    module constants (one source of truth), not hardcoded copies."""
+    assert str(bench.GOLDEN_LB1["tree"]) in bench._HOST_SEQ
+    assert str(bench.GOLDEN_LB2["tree"]) in bench._HOST_SEQ
+    assert str(bench.NQ_SOL[14]) in bench._HOST_SEQ
+    assert "@LB1_TREE@" not in bench._HOST_SEQ  # placeholders resolved
+
+
+@pytest.mark.skipif(
+    os.environ.get("TTS_BENCH_E2E", "0") != "1",
+    reason="multi-minute end-to-end bench run; set TTS_BENCH_E2E=1 "
+    "(hardware sessions / CI smoke run it)",
+)
+def test_express_mode_emits_minimal_tpu_gated_line():
+    """End-to-end express run on CPU: one JSON line, parity true, no
+    extras, backend recorded as cpu (so the watcher will NOT count it as
+    a banked on-chip number), and BENCH_LAST_GOOD untouched."""
+    lg_path = bench.LAST_GOOD_PATH
+    before = open(lg_path).read() if os.path.exists(lg_path) else None
+    env = {**os.environ, "TTS_BENCH_EXPRESS": "1",
+           "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+    res = subprocess.run(
+        [sys.executable, "bench.py"], capture_output=True, text=True,
+        timeout=900, env=env,
+        cwd=os.path.dirname(os.path.abspath(bench.__file__)),
+    )
+    line = res.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["express"] is True
+    assert rec["backend"] == "cpu"
+    assert rec["parity"] is True and rec["value"] > 0
+    assert rec["extra"] == []
+    assert rec["pallas"] is False
+    # The on_tpu banking guard: a CPU run must never touch the committed
+    # BENCH_LAST_GOOD.json.
+    after = open(lg_path).read() if os.path.exists(lg_path) else None
+    assert after == before, "CPU express run clobbered BENCH_LAST_GOOD"
