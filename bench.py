@@ -70,25 +70,88 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 V5E_PEAK_BF16_TFLOPS = 197.0
 
 
-def roofline(nps: float, n: int, m: int, P: int | None, lb: str) -> dict:
+def flops_per_parent_model(n: int, m: int, P: int | None, lb: str) -> float:
+    """Hand-counted FLOPs per explored parent of the jnp evaluators — the
+    fallback when XLA cost analysis is unavailable, cross-checked against it
+    by ``tests/test_bench.py``. lb1 = two (n, n) x (n, m) one-hot gathers
+    (2 * 2n^2m) plus the O(nm) scan and the m-chain over n children (~6nm);
+    lb2 adds, per machine pair, one (n, n) one-hot reorder contraction
+    (2n^2) and the O(n) closed-form Johnson scan (~8n) — NOT per-pair
+    (n, n) x (n, n) matmuls; the implementation is O(P n^2), which the
+    round-5 cost-analysis cross-check confirmed (the earlier 6n^3-per-pair
+    model overstated lb2 work ~67x)."""
+    if lb == "lb2":
+        return (P or 0) * (2.0 * n**2 + 8.0 * n) + 4.0 * n**2 * m
+    return 4.0 * n**2 * m + 6.0 * n * m
+
+
+def flops_per_parent_xla(problem, lb: str, batch: int = 64) -> float | None:
+    """Compiler-measured FLOPs per parent: lower + compile the jnp chunk
+    evaluator for the current backend and read XLA's cost analysis. This is
+    the authoritative roofline numerator — it counts what the compiled
+    program executes, not what a hand model assumes. Returns None when cost
+    analysis is unavailable (some backends) or the compile fails; callers
+    fall back to ``flops_per_parent_model``. The Pallas kernels do the same
+    semantic work with the same asymptotics (XLA cannot see inside a custom
+    call), so the jnp figure stands in for both paths."""
+    cache = getattr(problem, "_flops_per_parent_xla", None)
+    if cache is None:
+        cache = problem._flops_per_parent_xla = {}
+    if lb in cache:
+        return cache[lb]
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_tree_search.ops import pfsp_device as P
+
+        t = problem.device_tables()
+        n = problem.jobs
+        prmu = jnp.asarray(
+            np.tile(np.arange(n, dtype=np.int32), (batch, 1))
+        )
+        limit1 = jnp.zeros((batch,), dtype=jnp.int32)
+        # Lower the module-level jits with the tables as RUNTIME arguments —
+        # exactly how production calls them. A wrapper closure would bake
+        # the tables in as HLO constants and cost-analyse a
+        # differently-folded program.
+        if lb == "lb2":
+            lowered = P._lb2_chunk.lower(
+                prmu, limit1, t.ptm_t, t.min_heads, t.min_tails, t.pairs,
+                t.lags, t.johnson_schedules, bf16=t.exact_bf16,
+            )
+        else:
+            lowered = P._lb1_chunk.lower(
+                prmu, limit1, t.ptm_t, t.min_heads, t.min_tails,
+                bf16=t.exact_bf16,
+            )
+        ca = lowered.compile().cost_analysis()
+        flops = float(ca.get("flops", 0.0)) if ca else 0.0
+        cache[lb] = flops / batch if flops > 0 else None
+    except Exception:
+        cache[lb] = None
+    return cache[lb]
+
+
+def roofline(nps: float, n: int, m: int, P: int | None, lb: str,
+             problem=None) -> dict:
     """Achieved-work roofline for the headline run. ``nps`` counts explored
     parents/sec; every explored parent evaluates all n children in one
-    evaluator pass, so bound-evals/sec = nps * n. FLOP counts are what the
-    TPU evaluators actually execute per parent (not the reference's scalar
-    algorithm): lb1 = two (n, n) x (n, m) one-hot gathers (2 * 2n^2m) plus
-    the O(nm) scan and the m-chain over n children (~6nm); lb2 adds, per
-    machine pair, three (n, n) x (n, n) matmuls per parent (jord gather +
-    prefix + suffix triangular contractions, 6n^3 each 2 FLOPs/MAC).
+    evaluator pass, so bound-evals/sec = nps * n. FLOPs/parent comes from
+    XLA cost analysis of the compiled jnp evaluator when ``problem`` is
+    given (``flop_source: xla_cost_analysis``), else the hand model.
     ``mfu_pct`` is achieved-FLOPs / bf16 MXU peak — honest MFU for a
     branch-and-bound workload whose useful work is bounds, not FLOPs."""
-    if lb == "lb2":
-        flops_per_parent = (P or 0) * 6.0 * n**3 + 4.0 * n**2 * m
-    else:
-        flops_per_parent = 4.0 * n**2 * m + 6.0 * n * m
+    measured = flops_per_parent_xla(problem, lb) if problem is not None else None
+    flops_per_parent = (
+        measured if measured is not None
+        else flops_per_parent_model(n, m, P, lb)
+    )
     gflops = nps * flops_per_parent / 1e9
     return {
         "bound_evals_per_sec": round(nps * n, 1),
         "flops_per_parent": int(flops_per_parent),
+        "flop_source": "xla_cost_analysis" if measured is not None else "model",
         "achieved_gflops": round(gflops, 2),
         "peak_bf16_tflops": V5E_PEAK_BF16_TFLOPS,
         "mfu_pct": round(100.0 * gflops / (V5E_PEAK_BF16_TFLOPS * 1e3), 4),
@@ -443,11 +506,7 @@ def eval_microbench(problem, on_tpu: bool, iters: int = 20) -> dict:
 
     from tpu_tree_search.ops import pfsp_device as P
 
-    t = getattr(problem, "_device_tables", None)
-    if t is None:
-        t = problem._device_tables = P.PFSPDeviceTables(
-            problem.lb1_data, problem.lb2_data
-        )
+    t = problem.device_tables()
     n, m = problem.jobs, problem.machines
     B = 65536 if on_tpu else 4096
     rng = np.random.default_rng(5)
@@ -468,7 +527,7 @@ def eval_microbench(problem, on_tpu: bool, iters: int = 20) -> dict:
     # Same FLOP model + MFU formula as the search-loop roofline — the two
     # numbers must stay comparable (this microbench exists to cross-check
     # that roofline).
-    rl = roofline(parents_per_sec, n, m, None, "lb1")
+    rl = roofline(parents_per_sec, n, m, None, "lb1", problem=problem)
     return {
         "kernel": "lb1",
         "batch": B,
@@ -622,7 +681,7 @@ def main() -> int:
             "total_s": round(elapsed, 3),
             "kernel_launches": res.diagnostics.kernel_launches,
             "roofline": roofline(nps, prob_hl.jobs, prob_hl.machines, None,
-                                 "lb1"),
+                                 "lb1", problem=prob_hl),
         }
         # Measured kernel-only throughput on the same chunk shape: the
         # roofline's empirical cross-check (search MFU << kernel MFU means

@@ -339,10 +339,7 @@ class _PFSPResident(_ResidentProgram):
         from ..ops import pfsp_device as P
 
         prob = self.problem
-        t = getattr(prob, "_device_tables", None)
-        if t is None:
-            t = P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
-            prob._device_tables = t
+        t = prob.device_tables()
         lb = prob.lb
         n = prob.jobs
         device = self.device

@@ -106,7 +106,7 @@ class MeshEvaluator:
     def _build_pfsp(self, problem, mesh):
         from ..ops import pfsp_device
 
-        tables = pfsp_device.PFSPDeviceTables(problem.lb1_data, problem.lb2_data)
+        tables = problem.device_tables()
         jobs = problem.jobs
         lb = problem.lb
         if lb == "lb2":

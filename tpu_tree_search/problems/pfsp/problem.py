@@ -176,17 +176,22 @@ class PFSPProblem(Problem):
 
     # -- device path -------------------------------------------------------
 
-    def make_device_evaluator(self, device=None):
+    def device_tables(self):
+        """Per-instance device tables, built once and shared by all
+        offloaders/workers/benchmarks (the chunk kernels themselves are
+        module-level jits, so the compile cache is shared too)."""
         from ...ops import pfsp_device
 
-        # Tables are built once per problem instance and shared by all
-        # offloaders/workers (the chunk kernels themselves are module-level
-        # jits, so the compile cache is shared too).
         if not hasattr(self, "_device_tables"):
             self._device_tables = pfsp_device.PFSPDeviceTables(
                 self.lb1_data, self.lb2_data
             )
-        return pfsp_device.make_evaluator(self._device_tables, self.lb, device)
+        return self._device_tables
+
+    def make_device_evaluator(self, device=None):
+        from ...ops import pfsp_device
+
+        return pfsp_device.make_evaluator(self.device_tables(), self.lb, device)
 
     def generate_children(
         self, parents: NodeBatch, count: int, results: np.ndarray, best: int
