@@ -61,8 +61,21 @@ def make_labels(N: int, g: int = 1, device=None):
 
 
 @lru_cache(maxsize=None)
-def make_jitted_core(N: int, g: int = 1, device=None):
-    """Module-level jit cache keyed on (N, g, device): every DeviceOffloader
-    / worker thread shares one compiled kernel per bucket shape instead of
-    re-tracing per closure (cf. the module-level jitted PFSP chunk kernels)."""
+def _make_jitted_core(N: int, g: int, device, routing_key: tuple):
+    del routing_key  # cache key only — the knobs it captures are baked in
     return jax.jit(make_labels(N, g, device))
+
+
+def make_jitted_core(N: int, g: int = 1, device=None):
+    """Module-level jit cache: every DeviceOffloader / worker thread shares
+    one compiled kernel per bucket shape instead of re-tracing per closure
+    (cf. the module-level jitted PFSP chunk kernels). The env-dependent
+    routing decisions make_labels bakes in at trace time are part of the
+    key — flipping TTS_PALLAS / TTS_PALLAS_INTERPRET between searches must
+    rebuild, not reuse a stale core (same invariant as
+    ``pfsp_device.routing_cache_token``)."""
+    from . import pallas_kernels as PK
+
+    return _make_jitted_core(
+        N, g, device, (PK.use_pallas(device), PK.pallas_interpret())
+    )

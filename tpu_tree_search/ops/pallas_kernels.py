@@ -40,12 +40,26 @@ def use_pallas(device=None) -> bool:
     """
     if os.environ.get("TTS_PALLAS", "1") == "0":
         return False
+    if pallas_interpret():
+        return True
     try:
         if device is not None:
             return device.platform == "tpu"
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def pallas_interpret() -> bool:
+    """``TTS_PALLAS_INTERPRET=1`` routes the evaluators to the Pallas
+    kernels in interpret mode on ANY backend. This is the off-chip way to
+    drive compositions the CPU suite otherwise cannot reach — above all
+    pallas_call inside the mesh tiers' ``shard_map`` (the round-5 hardware
+    session caught a vma trace failure there that every CPU test missed
+    because ``use_pallas`` is False off-TPU). Kernel *math* runs
+    interpreted; routing, tracing, and the shard_map composition are the
+    real path. ``TTS_PALLAS=0`` still wins."""
+    return os.environ.get("TTS_PALLAS_INTERPRET", "0") == "1"
 
 
 def _round_up(x: int, k: int) -> int:
@@ -236,8 +250,10 @@ def _nqueens_call(N: int, g: int, B: int, tile: int, interpret: bool):
     )
 
 
-def nqueens_labels(board, depth, N: int, g: int = 1, interpret: bool = False):
+def nqueens_labels(board, depth, N: int, g: int = 1,
+                   interpret: bool | None = None):
     """(B, N) uint8 labels; same contract as `nqueens_device.make_core`."""
+    interpret = pallas_interpret() if interpret is None else interpret
     B = board.shape[0]
     tile = min(512, B)
     Bp = _round_up(B, tile)
@@ -445,10 +461,11 @@ def _lb1_d_kernel(
 
 
 def pfsp_lb1_d_bounds(
-    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False,
+    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool | None = None,
     bf16: bool = False,
 ):
     """(B, n) int32 lb1_d child bounds; same contract as `_lb1_d_chunk`."""
+    interpret = pallas_interpret() if interpret is None else interpret
     return _lb1_family_bounds(
         _lb1_d_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
         bf16, kernel_name="lb1d",
@@ -577,9 +594,10 @@ def _eager_context() -> bool:
         return False   # just re-transfers on eager calls)
 
 
-def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
+def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool | None = None,
                     bf16: bool | None = None):
     """(B, n) int32 lb2 child bounds; same contract as `_lb2_chunk`."""
+    interpret = pallas_interpret() if interpret is None else interpret
     if bf16 is None:
         bf16 = getattr(tables, "exact_bf16", False)
     B, n = prmu.shape
@@ -615,10 +633,11 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool = False,
 
 
 def pfsp_lb1_bounds(
-    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool = False,
+    prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool | None = None,
     bf16: bool = False,
 ):
     """(B, n) int32 lb1 child bounds; same contract as `_lb1_chunk`."""
+    interpret = pallas_interpret() if interpret is None else interpret
     return _lb1_family_bounds(
         _lb1_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
         bf16,
@@ -749,12 +768,14 @@ def _lb2_self_call(n: int, m: int, P: int, R: int, tile: int, interpret: bool,
 
 
 def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
-                                interpret: bool = False, bf16: bool = False):
+                                interpret: bool | None = None,
+                                bf16: bool = False):
     """`pfsp_lb2_self_bounds` over EXPLICIT ordered tables (possibly traced
     slices of the full pair set — the mp-sharded staged path slices each
     shard's contiguous pair block before the call; pallas_call takes traced
     operands like any other op). ``ordered`` needs p0_o/p1_o/lag_o (P, n),
     tails0/tails1 (P,), msel0/msel1 (P, m), jorder (P, n, n)."""
+    interpret = pallas_interpret() if interpret is None else interpret
     R, n = prmu.shape
     m = ptm_t.shape[1]
     P = ordered.lag_o.shape[0]
@@ -781,7 +802,8 @@ def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
 
 
 def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
-                         interpret: bool = False, bf16: bool | None = None):
+                         interpret: bool | None = None,
+                         bf16: bool | None = None):
     """(R,) int32 self lb2 bounds; rows >= n_active are garbage (their
     tiles are skipped entirely). Same contract as `_lb2_self_chunk` on the
     first n_active rows."""

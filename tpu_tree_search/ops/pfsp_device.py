@@ -591,7 +591,7 @@ def routing_cache_token(problem, device=None) -> tuple:
     resident and mesh-resident cache keys."""
     from . import pallas_kernels as PK
 
-    tok: tuple = (PK.use_pallas(device),)
+    tok: tuple = (PK.use_pallas(device), PK.pallas_interpret())
     if getattr(problem, "name", None) == "pfsp" and problem.lb == "lb2":
         tok += (
             _lb2_pallas_enabled(),
