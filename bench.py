@@ -69,6 +69,13 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # is the kernels' matmul mode (exact for the <2^8 one-hot/time operands).
 V5E_PEAK_BF16_TFLOPS = 197.0
 
+# Headline chunk size, measured on the real v5e (scripts/headline_tune.py,
+# round 5): per-cycle cost is ~linear in M (dense padded compute) while the
+# ta014 frontier rarely fills large chunks, so small-but-full chunks win —
+# M=1024 ran 1.87M nodes/s vs 1.46M at the old 65536 (28% on the same tree;
+# 512 underutilizes, the 1024-8192 plateau is flat within ~3%).
+HEADLINE_M = 1024
+
 
 def flops_per_parent_model(n: int, m: int, P: int | None, lb: str) -> float:
     """Hand-counted FLOPs per explored parent of the jnp evaluators — the
@@ -494,12 +501,15 @@ def probe_pallas(
     return True, None, True, None, ok3, err3
 
 
-def eval_microbench(problem, on_tpu: bool, iters: int = 20) -> dict:
+def eval_microbench(problem, on_tpu: bool, iters: int | None = None) -> dict:
     """Pure-evaluator throughput on the search's exact chunk shape — the
     measured cross-check for the model-derived roofline (VERDICT r4 weak
     #5): if the search-loop MFU sits far below this, the gap is
     orchestration (pool ops, compaction, dispatch), not the kernel; if they
-    match, the kernel is the ceiling."""
+    match, the kernel is the ceiling. B matches HEADLINE_M so (a) the
+    jnp-vs-Pallas headline-path pick is measured at the production chunk
+    shape, not a 64x bigger one, and (b) the compiles warm exactly the
+    evaluator the chosen path dispatches."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -508,7 +518,11 @@ def eval_microbench(problem, on_tpu: bool, iters: int = 20) -> dict:
 
     t = problem.device_tables()
     n, m = problem.jobs, problem.machines
-    B = 65536 if on_tpu else 4096
+    B = HEADLINE_M if on_tpu else 4096
+    if iters is None:
+        # Keep the timed section ~O(100ms) so small chunks don't measure
+        # noise: scale repetitions inversely with the batch.
+        iters = max(20, (65536 // B) * 20)
     rng = np.random.default_rng(5)
     prmu = rng.permuted(
         np.tile(np.arange(n, dtype=np.int32), (B, 1)), axis=1
@@ -655,11 +669,11 @@ def main() -> int:
         if headline_path == "jnp" and pallas_ok:
             with _env_override("TTS_PALLAS", "0"):
                 res, nps, elapsed, device_phase = run_config(
-                    prob_hl, m=25, M=65536
+                    prob_hl, m=25, M=HEADLINE_M
                 )
         else:
             res, nps, elapsed, device_phase = run_config(
-                prob_hl, m=25, M=65536
+                prob_hl, m=25, M=HEADLINE_M
             )
         parity = (
             res.explored_tree == GOLDEN_LB1["tree"]
@@ -727,9 +741,13 @@ def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
     from tpu_tree_search.problems import NQueensProblem, PFSPProblem
 
     try:
-        # CPU smoke: small chunks — the jnp lb2's per-pair (B, n, n)
-        # intermediates make huge chunks crawl without the TPU's bandwidth.
-        lb2_m, lb2_M = 25, (65536 if on_tpu else 4096)
+        # Chunk size measured on the real v5e (scripts/lb2_tune.py, round
+        # 5): like the headline, per-cycle cost scales with M while the
+        # heavily-pruned lb2 frontier rarely fills big chunks — staged
+        # M=1024 ran 158.8k nodes/s (2.43x ref C) vs 50.7k at the old
+        # 65536. CPU smoke keeps moderate chunks (jnp lb2's per-pair
+        # intermediates dominate there).
+        lb2_m, lb2_M = 25, (1024 if on_tpu else 4096)
         res2, nps2, _, _ = run_config(
             PFSPProblem(inst=14, lb="lb2", ub=1), m=lb2_m, M=lb2_M
         )

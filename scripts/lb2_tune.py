@@ -62,7 +62,8 @@ def main() -> int:
                     help="staged-only, 3 chunk sizes")
     args = ap.parse_args()
 
-    Ms = [4096, 16384, 65536] if args.quick else [2048, 4096, 16384, 65536]
+    Ms = ([1024, 2048, 4096] if args.quick
+          else [1024, 2048, 4096, 16384, 65536])
     stageds = ["1"] if args.quick else ["1", "0"]
     best = None
     for staged in stageds:

@@ -34,6 +34,28 @@ from tpu_tree_search.problems import NQueensProblem, PFSPProblem
 
 enable_compile_cache()
 kind = sys.argv[1]
+if kind == "kernel":
+    # Kernel-level warm at the smoke-gate shapes: large-instance resident
+    # programs explore tens of millions of nodes in ONE K=4096 dispatch
+    # (max_steps can't cut inside a dispatch), blowing the slot timeout on
+    # execution the cache doesn't need — the session's reusable artifacts
+    # for these classes are the Mosaic KERNEL compiles.
+    import jax.numpy as jnp
+    from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
+    inst, lb, B = int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
+    prob = PFSPProblem(inst=inst, lb=lb, ub=1)
+    t = prob.device_tables()
+    n = prob.jobs
+    prmu = jnp.tile(jnp.arange(n, dtype=jnp.int32), (B, 1))
+    limit1 = jnp.zeros((B,), dtype=jnp.int32)
+    if lb == "lb1":
+        out = PK.pfsp_lb1_bounds(prmu, limit1, t.ptm_t, t.min_heads,
+                                 t.min_tails, bf16=t.exact_bf16)
+    else:
+        out = PK.pfsp_lb2_bounds(prmu, limit1, t)
+    out.block_until_ready()
+    print(f"WARM_OK shape={tuple(out.shape)} wall={time.time() - t0:.1f}s")
+    sys.exit(0)
 if kind == "nqueens":
     prob = NQueensProblem(N=int(sys.argv[2]))
 else:
@@ -46,17 +68,25 @@ print(f"WARM_OK tree={res.explored_tree} wall={time.time() - t0:.1f}s")
 # (label, argv tail, env overrides) — the bench + smoke-gate matrix, most
 # valuable first so a closing window still banks the flagship programs.
 CONFIGS: list[tuple[str, list[str], dict[str, str]]] = [
-    ("ta014 lb2 staged M=65536", ["pfsp", "14", "lb2", "-", "65536"],
+    # M values match the bench's measured defaults (HEADLINE_M / lb2_M —
+    # scripts/headline_tune.py, scripts/lb2_tune.py): warming MUST compile
+    # the exact programs the bench dispatches.
+    ("ta014 lb2 staged M=1024", ["pfsp", "14", "lb2", "-", "1024"],
      {"TTS_LB2_STAGED": "1"}),
-    ("ta014 lb2 unstaged M=65536", ["pfsp", "14", "lb2", "-", "65536"],
+    ("ta014 lb2 unstaged M=1024", ["pfsp", "14", "lb2", "-", "1024"],
      {"TTS_LB2_STAGED": "0"}),
-    ("ta014 lb1 M=65536", ["pfsp", "14", "lb1", "-", "65536"], {}),
-    ("ta014 lb1_d M=65536", ["pfsp", "14", "lb1_d", "-", "65536"], {}),
+    ("ta014 lb1 M=1024 jnp", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_PALLAS": "0"}),
+    ("ta014 lb1 M=1024", ["pfsp", "14", "lb1", "-", "1024"], {}),
+    ("ta014 lb1_d M=1024", ["pfsp", "14", "lb1_d", "-", "1024"], {}),
     ("nqueens N=15 M=65536", ["nqueens", "15", "65536"], {}),
-    # Large-instance classes (VERDICT r4 #7): ta056 = 50x20, ta111 = 500x20.
-    ("ta056 lb1 M=4096", ["pfsp", "56", "lb1", "-", "4096"], {}),
-    ("ta056 lb2 M=4096", ["pfsp", "56", "lb2", "-", "4096"], {}),
-    ("ta111 lb1 M=1024", ["pfsp", "111", "lb1", "-", "1024"], {}),
+    # Large-instance classes (VERDICT r4 #7): ta031 = 50x10, ta056 = 50x20,
+    # ta111 = 500x20. Kernel-level at the smoke-gate shapes (see _ITEM's
+    # "kernel" note); the set mirrors test_large_instance_kernels_compile_on_tpu.
+    ("ta031 lb1 kernel B=64", ["kernel", "31", "lb1", "64"], {}),
+    ("ta056 lb1 kernel B=32", ["kernel", "56", "lb1", "32"], {}),
+    ("ta056 lb2 kernel B=16", ["kernel", "56", "lb2", "16"], {}),
+    ("ta111 lb1 kernel B=16", ["kernel", "111", "lb1", "16"], {}),
 ]
 
 
@@ -77,8 +107,11 @@ def main() -> int:
         except subprocess.TimeoutExpired:
             ok, detail = False, f"timeout {timeout_s:.0f}s"
         failures += not ok
+        # flush: the session log must stream per-config progress (a redirect
+        # block-buffers prints, hiding everything until exit — observed when
+        # the tunnel died mid-run and the log stayed empty).
         print(f"{'ok ' if ok else 'FAIL'} {time.time() - t0:7.1f}s  "
-              f"{label}  {detail}")
+              f"{label}  {detail}", flush=True)
     return 1 if failures else 0
 
 
