@@ -520,9 +520,11 @@ def eval_microbench(problem, on_tpu: bool, iters: int | None = None) -> dict:
     n, m = problem.jobs, problem.machines
     B = HEADLINE_M if on_tpu else 4096
     if iters is None:
-        # Keep the timed section ~O(100ms) so small chunks don't measure
-        # noise: scale repetitions inversely with the batch.
-        iters = max(20, (65536 // B) * 20)
+        # Keep the timed section comparable to the old B=65536 runs so
+        # small chunks don't measure noise: scale repetitions inversely
+        # with the batch, per backend (CPU's B is unchanged -> 20).
+        base = 65536 if on_tpu else 4096
+        iters = max(20, (base // B) * 20)
     rng = np.random.default_rng(5)
     prmu = rng.permuted(
         np.tile(np.arange(n, dtype=np.int32), (B, 1)), axis=1

@@ -21,8 +21,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-GOLDEN = {"tree": 2_573_652, "sol": 2648, "makespan": 1377}
-REF_C_LB1 = 927_909.0  # measured reference C sequential (BASELINE.md)
+from bench import GOLDEN_LB1 as GOLDEN, REF_C_SEQ  # noqa: E402 — canonical anchors
+
+REF_C_LB1 = REF_C_SEQ["pfsp_ta014_lb1"]
 
 
 def run_one(M: int, K: int) -> dict:

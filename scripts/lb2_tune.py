@@ -21,8 +21,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-GOLDEN = {"tree": 144_639, "sol": 0, "makespan": 1377}
-REF_C_LB2 = 65_391.0  # measured reference C sequential (BASELINE.md)
+from bench import GOLDEN_LB2 as GOLDEN, REF_C_SEQ  # noqa: E402 — canonical anchors
+
+REF_C_LB2 = REF_C_SEQ["pfsp_ta014_lb2"]
 
 
 def run_one(m: int, M: int, staged: str) -> dict:

@@ -41,7 +41,7 @@ if kind == "kernel":
     # execution the cache doesn't need — the session's reusable artifacts
     # for these classes are the Mosaic KERNEL compiles.
     import jax.numpy as jnp
-    from tpu_tree_search.ops import pfsp_device as P, pallas_kernels as PK
+    from tpu_tree_search.ops import pallas_kernels as PK
     inst, lb, B = int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
     prob = PFSPProblem(inst=inst, lb=lb, ub=1)
     t = prob.device_tables()

@@ -74,3 +74,35 @@ def test_flag_validation(argv, msg, capsys):
         cli.main(argv)
     assert e.value.code == 2
     assert msg in capsys.readouterr().err
+
+
+@pytest.mark.parametrize("M,name,tier,engine,backend,expect", [
+    (7777, "pfsp", "device", "resident", "tpu", 7777),  # explicit wins
+    (None, "pfsp", "device", "resident", "tpu", 1024),  # measured default
+    (None, "pfsp", "device", "resident", "cpu", 50000),  # unmeasured backend
+    (None, "pfsp", "device", "offload", "tpu", 50000),  # per-chunk round trip
+    (None, "pfsp", "mesh", "resident", "tpu", 50000),   # sharded: per shard
+    (None, "nqueens", "device", "resident", "tpu", 50000),  # wide frontier
+])
+def test_resolve_chunk_size(M, name, tier, engine, backend, expect):
+    """--M defaults come from the round-5 on-chip tuning
+    (docs/HW_VALIDATION.md); explicit values, the offload engine, and
+    unmeasured combinations keep the reference's 50000 (`util.chpl`)."""
+    assert cli.resolve_chunk_size(M, name, tier, engine, backend) == expect
+
+
+def test_resolve_chunk_size_non_candidates_skip_backend_probe():
+    """--tier seq (and every non-candidate) must not import/initialize jax
+    just to compute a chunk size it discards."""
+    import builtins
+    from unittest import mock
+
+    real_import = builtins.__import__
+
+    def guarded(name, *a, **kw):
+        assert name != "jax", "non-candidate resolved the backend"
+        return real_import(name, *a, **kw)
+
+    with mock.patch.object(builtins, "__import__", side_effect=guarded):
+        assert cli.resolve_chunk_size(None, "nqueens", "seq", "resident") == 50000
+        assert cli.resolve_chunk_size(None, "pfsp", "device", "offload") == 50000

@@ -46,7 +46,12 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     common.add_argument("--m", type=int, default=25, help="minimum chunk size")
-    common.add_argument("--M", type=int, default=50000, help="maximum chunk size")
+    common.add_argument(
+        "--M", type=int, default=None,
+        help="maximum chunk size (default: measured per problem/backend — "
+        "1024 for PFSP device tiers on TPU, else the reference's 50000; "
+        "see docs/HW_VALIDATION.md chunk-size tuning)",
+    )
     common.add_argument("--K", type=int, default=None,
                         help="resident tiers: device chunk cycles per host "
                         "dispatch (default 4096 device / 16 mesh)")
@@ -184,7 +189,40 @@ def make_problem(args):
     return PFSPProblem(inst=args.inst, lb=args.lb, ub=args.ub)
 
 
+def resolve_chunk_size(M, problem_name: str, tier: str, engine: str,
+                       backend: str | None = None) -> int:
+    """Measured default for ``--M`` when the user does not pass one.
+
+    On-chip tuning (scripts/headline_tune.py / lb2_tune.py, round 5 —
+    docs/HW_VALIDATION.md) showed the RESIDENT loop's per-cycle cost is
+    ~linear in M while PFSP frontiers rarely fill large chunks, so
+    small-but-full chunks run ~1.3x (lb1) to ~3x (staged lb2) faster:
+    PFSP device tier + resident engine on TPU defaults to 1024.
+    Everything else — explicit ``--M``, the offload engine (each chunk
+    pays a ~360ms host round trip; small chunks would multiply them),
+    non-TPU backends (unmeasured), N-Queens (wide frontiers fill big
+    chunks), and the sharded tiers (M is per shard) — keeps the
+    reference's 50000 (`util.chpl` default). The candidate combination is
+    checked BEFORE the backend so non-candidates (e.g. ``--tier seq``)
+    never touch jax."""
+    if M is not None:
+        return M
+    if not (problem_name == "pfsp" and tier == "device"
+            and engine == "resident"):
+        return 50000
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+    return 1024 if backend == "tpu" else 50000
+
+
 def run_tier(problem, args):
+    args.M = resolve_chunk_size(args.M, getattr(problem, "name", ""),
+                                args.tier, args.engine)
     ckpt_kw = dict(
         max_steps=args.max_steps,
         checkpoint_path=args.checkpoint,
