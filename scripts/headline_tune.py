@@ -1,14 +1,20 @@
 #!/usr/bin/env python
-"""On-chip tuning grid for the HEADLINE bench config (ta014 lb1 ub=1).
+"""On-chip chunk-size tuning grids for the bench configs.
 
-The round-5 session measured 34 ms/cycle at M=65536 while the kernel
-microbench implies ~4 ms of bound math per cycle — most of the cycle is
-orchestration (pop/compact/push) whose cost scales differently with chunk
-size than the kernel does. This grid sweeps M (and K to expose fixed
-per-dispatch overhead) and prints per-cycle decompositions so the bench
-default can be set from measurement instead of habit.
+The round-5 session measured 34 ms/cycle at M=65536 on the lb1 headline
+while the kernel microbench implies ~4 ms of bound math per cycle — most
+of the cycle is orchestration (pop/compact/push) whose cost is ~linear in
+M (dense padded compute), so chunk size must match how full the frontier
+keeps the chunks. This grid sweeps M (and K to expose fixed per-dispatch
+overhead) and prints per-cycle decompositions so bench defaults are set
+from measurement instead of habit. Measured outcomes so far are recorded
+in docs/HW_VALIDATION.md ("chunk-size tuning").
 
-Run on the TPU host:  python scripts/headline_tune.py [--quick]
+Run on the TPU host:
+    python scripts/headline_tune.py [--quick]              # ta014 lb1
+    python scripts/headline_tune.py --problem nqueens      # N-Queens N=15
+(N-Queens has no pruning, so its frontier FILLS large chunks — the sweep
+spans upward to find whether bigger-than-65536 chunks pay.)
 """
 
 from __future__ import annotations
@@ -21,16 +27,32 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import GOLDEN_LB1 as GOLDEN, REF_C_SEQ  # noqa: E402 — canonical anchors
-
-REF_C_LB1 = REF_C_SEQ["pfsp_ta014_lb1"]
+from bench import GOLDEN_LB1, NQ_SOL, REF_C_SEQ  # noqa: E402 — canonical anchors
 
 
-def run_one(M: int, K: int) -> dict:
+def run_one(problem_name: str, M: int, K: int) -> dict:
     from tpu_tree_search.engine.resident import resident_search
-    from tpu_tree_search.problems import PFSPProblem
 
-    prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+    if problem_name == "nqueens":
+        from tpu_tree_search.problems import NQueensProblem
+
+        mk = lambda: NQueensProblem(N=15)
+        anchor = REF_C_SEQ["nqueens_n15"]
+        check = lambda r: r.explored_sol == NQ_SOL[15]
+    else:
+        from tpu_tree_search.problems import PFSPProblem
+
+        mk = lambda: PFSPProblem(inst=14, lb="lb1", ub=1)
+        anchor = REF_C_SEQ["pfsp_ta014_lb1"]
+        check = lambda r: (
+            r.explored_tree == GOLDEN_LB1["tree"]
+            and r.explored_sol == GOLDEN_LB1["sol"]
+            and r.best == GOLDEN_LB1["makespan"]
+        )
+    # ONE instance for warm + timed: compiled programs are cached on the
+    # problem object, so a fresh instance would re-trace inside the timed
+    # run and inflate every measurement.
+    prob = mk()
     resident_search(prob, m=25, M=M, K=K)  # compile + warm
     t0 = time.time()
     res = resident_search(prob, m=25, M=M, K=K)
@@ -41,42 +63,51 @@ def run_one(M: int, K: int) -> dict:
     cycles = max(1, res.diagnostics.kernel_launches)
     nps = res.explored_tree / max(device_phase, 1e-9)
     return {
-        "M": M, "K": K,
+        "problem": problem_name, "M": M, "K": K,
         "nodes_per_sec": round(nps, 1),
-        "vs_ref_c_seq": round(nps / REF_C_LB1, 3),
+        "vs_ref_c_seq": round(nps / anchor, 3),
         "device_phase_s": round(device_phase, 3),
+        "total_s": round(elapsed, 3),
         "cycles": cycles,
         "ms_per_cycle": round(1e3 * device_phase / cycles, 2),
         "parents_per_cycle": round(res.explored_tree / cycles, 1),
-        "parity": (
-            res.explored_tree == GOLDEN["tree"]
-            and res.explored_sol == GOLDEN["sol"]
-            and res.best == GOLDEN["makespan"]
-        ),
+        "parity": check(res),
     }
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--problem", choices=["pfsp", "nqueens"], default="pfsp")
     args = ap.parse_args()
 
-    grid = (
-        [(1024, 4096), (2048, 4096), (4096, 4096)]
-        if args.quick else
-        # 512-131072 spans underutilization -> the measured 1024-8192
-        # plateau -> padded-compute collapse; K=1 exposes per-dispatch
-        # overhead (measured ~360ms through the axon tunnel).
-        [(512, 4096), (1024, 4096), (2048, 4096), (4096, 4096),
-         (8192, 4096), (32768, 4096), (65536, 4096), (131072, 4096),
-         (65536, 1)]
-    )
+    if args.problem == "nqueens":
+        # No pruning -> the frontier fills any chunk; sweep UP from the
+        # current 65536 to find where padded-compute cost overtakes fill.
+        grid = (
+            [(32768, 4096), (65536, 4096), (131072, 4096)]
+            if args.quick else
+            [(8192, 4096), (32768, 4096), (65536, 4096), (131072, 4096),
+             (262144, 4096)]
+        )
+    else:
+        grid = (
+            [(1024, 4096), (2048, 4096), (4096, 4096)]
+            if args.quick else
+            # 512-131072 spans underutilization -> the measured 1024-8192
+            # plateau -> padded-compute collapse; K=1 exposes per-dispatch
+            # overhead (measured ~360ms through the axon tunnel).
+            [(512, 4096), (1024, 4096), (2048, 4096), (4096, 4096),
+             (8192, 4096), (32768, 4096), (65536, 4096), (131072, 4096),
+             (65536, 1)]
+        )
     best = None
     for M, K in grid:
         try:
-            row = run_one(M, K)
+            row = run_one(args.problem, M, K)
         except Exception as e:  # noqa: BLE001 — keep sweeping
-            row = {"M": M, "K": K, "error": f"{type(e).__name__}: {e}"}
+            row = {"problem": args.problem, "M": M, "K": K,
+                   "error": f"{type(e).__name__}: {e}"}
         print(json.dumps(row), flush=True)
         if row.get("parity") and (
             best is None or row["nodes_per_sec"] > best["nodes_per_sec"]
