@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Decompose the resident cycle's on-chip time: evaluator vs orchestration.
+
+Round-5 measurement: the ta014 lb1 cycle costs ~0.55 us/parent end to end
+while the evaluator microbench implies ~0.065 us/parent — an ~8x gap that
+is flat in M, i.e. proportional work somewhere in pop/compact/push or in
+how the evaluator fuses INSIDE the while_loop. This script times, at the
+same (M, n) shapes on the real chip:
+
+  a. the full program step (K cycles of the real while_loop), per cycle;
+  b. the jitted evaluator alone on one chunk;
+  c. a stripped while_loop whose body runs ONLY the evaluator + counter
+     bookkeeping (no dynamic_slice pop, no compaction, no push);
+  d. a stripped while_loop with pop + evaluator (no compact/push).
+
+(b vs c) isolates while-loop/fusion-context cost of the evaluator itself;
+(c vs d) prices the pop; (d vs a) prices compaction + push. Run on the TPU
+host:  python scripts/cycle_profile.py [--M 1024] [--cycles 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(fn, *args, iters=5):
+    out = fn(*args)
+    jax_block(out)
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args)
+    jax_block(out)
+    return (time.time() - t0) / iters
+
+
+def jax_block(out):
+    import jax
+
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
+        else x, out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--M", type=int, default=1024)
+    ap.add_argument("--cycles", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from tpu_tree_search.engine.device import warmup
+    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
+    from tpu_tree_search.pool import SoAPool
+    from tpu_tree_search.problems import PFSPProblem
+    from tpu_tree_search.problems.base import index_batch
+
+    M, K = args.M, args.cycles
+    prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+    n = prob.child_slots
+    capacity, M = resolve_capacity(prob, M, None)
+    device = jax.devices()[0]
+    prog = _make_program(prob, 25, M, K, capacity, device)
+
+    # A realistic mid-search frontier: warm up on host until > 4*M nodes so
+    # every profiled cycle pops a FULL chunk.
+    pool = SoAPool(prob.node_fields())
+    pool.push_back(index_batch(prob.root(), 0))
+    warmup(prob, pool, prob.initial_ub, 4 * M + 64)
+    ub = int(prob.initial_ub)
+
+    rows = {}
+
+    # a. real step (fresh state each call would change the tree; reuse the
+    # same initial state — donation rules out reuse, so rebuild per call).
+    def real_step():
+        s = prog.init_state(pool.as_batch(), prob.initial_ub)
+        return prog.step(s)
+
+    t_prep = timed(lambda: prog.init_state(pool.as_batch(), prob.initial_ub))
+    # The real loop may exit before K cycles (frontier drain / capacity
+    # guard): divide by the ACTUAL executed cycle count it reports.
+    real_cycles = int(real_step()[-1])
+    if real_cycles == 0:
+        print(json.dumps({"error": "real step ran 0 cycles; lower --M"}))
+        return 1
+    t_real = timed(real_step)
+    rows["a_real_cycles"] = real_cycles
+    rows["a_full_step_ms_per_cycle"] = round(
+        1e3 * (t_real - t_prep) / real_cycles, 3)
+
+    # b. evaluator alone on one full chunk (the microbench, at this M).
+    evaluate = prog._make_eval()
+    vals = jnp.asarray(
+        np.tile(np.arange(n, dtype=np.int32), (M, 1))
+    )
+    aux = jnp.zeros((M,), jnp.int32)
+    valid = jnp.ones((M,), bool)
+    ev = jax.jit(lambda v, a, vd: evaluate(v, a, vd, ub))
+    rows["b_eval_alone_ms"] = round(1e3 * timed(ev, vals, aux, valid), 3)
+
+    # c. while_loop with evaluator-only body (same carry/trip count).
+    def mk_loop(with_pop: bool):
+        C = capacity
+
+        def body(carry):
+            pool_vals, pool_aux, size, best, tree, sol, cycles = carry
+            if with_pop:
+                cnt = jnp.minimum(size, M)
+                start2 = jnp.clip(size - cnt, 0, C - M)
+                v_c = lax.dynamic_slice(
+                    pool_vals, (start2, 0), (M, n)).astype(jnp.int32)
+                a_c = lax.dynamic_slice(
+                    pool_aux, (start2,), (M,)).astype(jnp.int32)
+                vd = jnp.arange(M, dtype=jnp.int32) < cnt
+            else:
+                v_c, a_c, vd = vals.astype(jnp.int32), aux, valid
+            keep, sol_inc, best = evaluate(v_c, a_c, vd, best)
+            # Fold keep into the counters so nothing is dead-code-eliminated.
+            tree = tree + jnp.sum(keep, dtype=jnp.int32)
+            return (pool_vals, pool_aux, size, best, tree,
+                    sol + sol_inc * 0 + 1, cycles + 1)
+
+        def cond(carry):
+            return carry[-1] < K
+
+        def run(pool_vals, pool_aux):
+            zero = jnp.int32(0)
+            return lax.while_loop(cond, body, (
+                pool_vals, pool_aux, jnp.int32(4 * M), jnp.int32(ub),
+                zero, zero, zero))
+
+        return jax.jit(run)
+
+    pv = jnp.zeros((capacity, n), prog.pool_fields[0][1])
+    pa = jnp.zeros((capacity,), prog.pool_fields[1][1])
+    rows["c_eval_only_loop_ms_per_cycle"] = round(
+        1e3 * timed(mk_loop(False), pv, pa) / K, 3)
+    rows["d_pop_plus_eval_loop_ms_per_cycle"] = round(
+        1e3 * timed(mk_loop(True), pv, pa) / K, 3)
+
+    rows["M"] = M
+    rows["implied_compact_push_ms"] = round(
+        rows["a_full_step_ms_per_cycle"]
+        - rows["d_pop_plus_eval_loop_ms_per_cycle"], 3)
+    print(json.dumps(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

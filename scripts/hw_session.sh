@@ -46,6 +46,11 @@ echo "== 6/7 chunk-size sweeps (un-measured configs first) =="
 timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
 timeout 1200 python scripts/headline_tune.py --quick || true
 timeout 1200 python scripts/lb2_tune.py --quick || true
+# Cycle decomposition: where the non-evaluator ~85% of the cycle goes
+# (evaluator-in-loop vs alone, pop, compact+push) at the tuned and the
+# old chunk sizes.
+timeout 900 python scripts/cycle_profile.py --M 1024 || true
+timeout 900 python scripts/cycle_profile.py --M 65536 --cycles 16 || true
 
 echo "== 7/7 tile sweep (per-kernel compile/throughput; informational) =="
 # Full ta014 tables were measured in the round-5 session
