@@ -668,15 +668,44 @@ def main() -> int:
         # -- headline: PFSP ta014 lb1 --------------------------------------
         # A jnp demotion is scoped to THIS run: the lb2/nqueens extras have
         # their own kernels, which the lb1 microbench says nothing about.
-        if headline_path == "jnp" and pallas_ok:
-            with _env_override("TTS_PALLAS", "0"):
-                res, nps, elapsed, device_phase = run_config(
-                    prob_hl, m=25, M=HEADLINE_M
-                )
+        def _headline_run():
+            if headline_path == "jnp" and pallas_ok:
+                with _env_override("TTS_PALLAS", "0"):
+                    return run_config(prob_hl, m=25, M=HEADLINE_M)
+            return run_config(prob_hl, m=25, M=HEADLINE_M)
+
+        compact_stats = None
+        if on_tpu and not express:
+            # Empirical compaction pick (cf. the jnp-vs-Pallas pick above):
+            # scatter serializes on TPU, sort loses on CPU — measure both
+            # on the production config, bank the winner, record both. One
+            # problem instance is fine: the program cache keys on the
+            # routing token, which includes TTS_COMPACT.
+            runs = {}
+            for mode in ("scatter", "sort"):
+                with _env_override("TTS_COMPACT", mode):
+                    runs[mode] = _headline_run()
+
+            def _run_parity(r) -> bool:
+                return (r[0].explored_tree == GOLDEN_LB1["tree"]
+                        and r[0].explored_sol == GOLDEN_LB1["sol"]
+                        and r[0].best == GOLDEN_LB1["makespan"])
+
+            # Fastest PARITY-PASSING mode: a fast-but-wrong mode must never
+            # displace a clean measurement (the bank gate requires parity).
+            clean = {k: v for k, v in runs.items() if _run_parity(v)}
+            pool_ = clean or runs
+            pick = max(pool_, key=lambda k: pool_[k][1])
+            compact_stats = {
+                "picked": pick,
+                "nodes_per_sec": {
+                    k: round(v[1], 1) for k, v in runs.items()
+                },
+                "parity": {k: _run_parity(v) for k, v in runs.items()},
+            }
+            res, nps, elapsed, device_phase = runs[pick]
         else:
-            res, nps, elapsed, device_phase = run_config(
-                prob_hl, m=25, M=HEADLINE_M
-            )
+            res, nps, elapsed, device_phase = _headline_run()
         parity = (
             res.explored_tree == GOLDEN_LB1["tree"]
             and res.explored_sol == GOLDEN_LB1["sol"]
@@ -699,6 +728,8 @@ def main() -> int:
             "roofline": roofline(nps, prob_hl.jobs, prob_hl.machines, None,
                                  "lb1", problem=prob_hl),
         }
+        if compact_stats is not None:
+            record["compact"] = compact_stats
         # Measured kernel-only throughput on the same chunk shape: the
         # roofline's empirical cross-check (search MFU << kernel MFU means
         # the gap is orchestration, not the kernel) — and the basis of the

@@ -64,6 +64,10 @@ def run_one(problem_name: str, M: int, K: int) -> dict:
     nps = res.explored_tree / max(device_phase, 1e-9)
     return {
         "problem": problem_name, "M": M, "K": K,
+        # Trace-time knobs that change what this row measured — without
+        # them an A/B session log's rows are indistinguishable.
+        "compact": os.environ.get("TTS_COMPACT", "scatter"),
+        "pallas": os.environ.get("TTS_PALLAS", "1") != "0",
         "nodes_per_sec": round(nps, 1),
         "vs_ref_c_seq": round(nps / anchor, 3),
         "device_phase_s": round(device_phase, 3),

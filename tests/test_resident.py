@@ -180,3 +180,58 @@ def test_staged_knob_flip_rebuilds_program_same_instance(monkeypatch):
     assert (r3.explored_tree, r3.explored_sol, r3.best) == (
         r1.explored_tree, r1.explored_sol, r1.best
     )
+
+
+def test_compact_ids_sort_matches_scatter(monkeypatch):
+    """The two compaction implementations (TTS_COMPACT) must return
+    IDENTICAL ids for every live position — same survivors, same
+    (parent, slot) order — across dense, sparse, empty, and full masks."""
+    import numpy as np
+
+    from tpu_tree_search.engine.resident import _compact_ids
+
+    rng = np.random.default_rng(3)
+    cases = [
+        rng.random((64, 20)) < p for p in (0.0, 0.03, 0.35, 1.0)
+    ] + [np.zeros((1, 7), bool), np.ones((5, 3), bool)]
+    for keep in cases:
+        S = keep.size  # full budget: exercises every survivor position
+        monkeypatch.setenv("TTS_COMPACT", "scatter")
+        ids_sc, inc_sc = (np.asarray(x) for x in _compact_ids(keep, S))
+        monkeypatch.setenv("TTS_COMPACT", "sort")
+        ids_so, inc_so = (np.asarray(x) for x in _compact_ids(keep, S))
+        assert inc_sc == inc_so == keep.sum()
+        np.testing.assert_array_equal(ids_sc[:inc_sc], ids_so[:inc_so])
+
+
+def test_compact_knob_parity_end_to_end(monkeypatch):
+    """A full resident search under each TTS_COMPACT mode hits the same
+    exact counts (fresh problem per mode: programs cache on the instance,
+    keyed by the routing token that includes the knob)."""
+    ptm = taillard.reduced_instance(14, jobs=9, machines=5)
+    opt = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm)).best
+    results = {}
+    for mode in ("scatter", "sort"):
+        monkeypatch.setenv("TTS_COMPACT", mode)
+        res = resident_search(
+            PFSPProblem(lb="lb1", ub=0, p_times=ptm), m=8, M=128, K=32,
+            initial_best=opt,
+        )
+        results[mode] = (res.explored_tree, res.explored_sol, res.best)
+    assert results["scatter"] == results["sort"]
+
+
+def test_compact_knob_flip_rebuilds_program_same_instance(monkeypatch):
+    """Flipping TTS_COMPACT between searches on ONE problem instance must
+    rebuild the resident program (the knob is part of the routing token),
+    not silently reuse the stale compaction."""
+    prob = NQueensProblem(N=9)
+    seq = sequential_search(prob)
+    monkeypatch.setenv("TTS_COMPACT", "scatter")
+    r1 = resident_search(prob, m=8, M=128, K=32)
+    monkeypatch.setenv("TTS_COMPACT", "sort")
+    r2 = resident_search(prob, m=8, M=128, K=32)
+    assert (r1.explored_tree, r1.explored_sol) == (
+        seq.explored_tree, seq.explored_sol)
+    assert (r2.explored_tree, r2.explored_sol) == (
+        seq.explored_tree, seq.explored_sol)

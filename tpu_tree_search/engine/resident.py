@@ -89,10 +89,14 @@ def _compact_ids(keep, S: int):
     ids[s] = flat index i*n+k of the s-th survivor in (parent, slot) order
     for s < tree_inc (the reference's child push order,
     `pfsp_gpu_chpl.chpl:276-298`). Ranks are computed hierarchically (lane
-    scan + per-parent prefix) — much cheaper than a flat M*n cumsum — and
-    the inverse permutation is one scatter of int32 ids, not of node rows.
-    """
+    scan + per-parent prefix) — much cheaper than a flat M*n cumsum. The
+    rank inversion is either a stable argsort of ranked keys (survivors
+    carry their unique rank, non-survivors the max key, so sorted position
+    s holds exactly the rank-s survivor) or one int32-id scatter
+    (``compact_mode``)."""
     import jax.numpy as jnp
+
+    from ..ops.pfsp_device import compact_mode
 
     M, n = keep.shape
     cnt = jnp.sum(keep, axis=1, dtype=jnp.int32)  # (M,)
@@ -101,10 +105,15 @@ def _compact_ids(keep, S: int):
     ranks = offs[:, None] + lane  # (M, n)
     tree_inc = offs[-1] + cnt[-1]
     Mn = M * n
+    flat = keep.reshape(Mn)
+    if compact_mode() == "sort":
+        key = jnp.where(flat, ranks.reshape(Mn), jnp.int32(Mn))
+        ids = jnp.argsort(key, stable=True)[:S].astype(jnp.int32)
+        return ids, tree_inc
     flat_idx = jnp.arange(Mn, dtype=jnp.int32)
     # Non-survivors get distinct out-of-bounds destinations so the scatter
     # is genuinely unique-indexed (mode="drop" discards them).
-    dst = jnp.where(keep.reshape(Mn), ranks.reshape(Mn), S + flat_idx)
+    dst = jnp.where(flat, ranks.reshape(Mn), S + flat_idx)
     ids = (
         jnp.zeros((S,), jnp.int32)
         .at[dst]

@@ -581,6 +581,31 @@ def lb2_staged_enabled(device=None, n: int | None = None) -> bool:
             and (n is None or n <= 100))
 
 
+def compact_mode() -> str:
+    """``TTS_COMPACT`` selects the stream-compaction implementation baked
+    into the resident programs at trace time (`engine/resident.py
+    _compact_ids`): ``scatter`` (the original inverse-permutation scatter,
+    default) or ``sort`` (stable argsort of ranked keys). Motivation:
+    XLA:TPU lowers large general scatters to a mostly-serial loop (tens of
+    ns per index), and the round-5 cycle arithmetic puts the (M*n)-index
+    compaction scatter as the dominant non-evaluator cost at every chunk
+    size — the sort form instead uses the TPU's vectorized sort. On CPU
+    the scatter is a fast gather-like op and sort LOSES ~2x, so the
+    default stays ``scatter`` until a hardware measurement flips it;
+    ``bench.py`` compares both on chip and picks empirically per run.
+    Both produce identical ids in identical order; CI pins parity across
+    the knob. Lives here, next to the other routing knobs, so the token
+    below never imports upward from the engine layer."""
+    import os
+
+    mode = os.environ.get("TTS_COMPACT", "scatter")
+    if mode not in ("scatter", "sort"):
+        raise ValueError(
+            f"TTS_COMPACT must be 'scatter' or 'sort', got {mode!r}"
+        )
+    return mode
+
+
 def routing_cache_token(problem, device=None) -> tuple:
     """Every env-dependent kernel-routing decision that gets baked into a
     compiled program at trace time (Pallas vs jnp, the lb2-family kill
@@ -591,7 +616,8 @@ def routing_cache_token(problem, device=None) -> tuple:
     resident and mesh-resident cache keys."""
     from . import pallas_kernels as PK
 
-    tok: tuple = (PK.use_pallas(device), PK.pallas_interpret())
+    tok: tuple = (PK.use_pallas(device), PK.pallas_interpret(),
+                  compact_mode())
     if getattr(problem, "name", None) == "pfsp" and problem.lb == "lb2":
         tok += (
             _lb2_pallas_enabled(),
