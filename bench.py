@@ -682,7 +682,7 @@ def main() -> int:
             # problem instance is fine: the program cache keys on the
             # routing token, which includes TTS_COMPACT.
             runs = {}
-            for mode in ("scatter", "sort"):
+            for mode in ("scatter", "sort", "search"):
                 with _env_override("TTS_COMPACT", mode):
                     runs[mode] = _headline_run()
 

@@ -46,10 +46,12 @@ echo "== 6/7 chunk-size sweeps (un-measured configs first) =="
 timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
 timeout 1200 python scripts/headline_tune.py --quick || true
 timeout 1200 python scripts/lb2_tune.py --quick || true
-# Compaction A/B: the serialized-scatter hypothesis says sort-based
-# compaction should beat the default scatter on TPU; this pass quantifies
-# it on the same grid (bench also picks empirically per run).
+# Compaction A/B/C: the serialized-scatter hypothesis says sort- or
+# search-based compaction should beat the default scatter on TPU; these
+# passes quantify it on the same grid (rows are tagged with the mode;
+# bench also picks empirically per run).
 TTS_COMPACT=sort timeout 1200 python scripts/headline_tune.py --quick || true
+TTS_COMPACT=search timeout 1200 python scripts/headline_tune.py --quick || true
 # Cycle decomposition: where the non-evaluator ~85% of the cycle goes
 # (evaluator-in-loop vs alone, pop, compact+push) at the tuned and the
 # old chunk sizes.

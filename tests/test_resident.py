@@ -198,10 +198,11 @@ def test_compact_ids_sort_matches_scatter(monkeypatch):
         S = keep.size  # full budget: exercises every survivor position
         monkeypatch.setenv("TTS_COMPACT", "scatter")
         ids_sc, inc_sc = (np.asarray(x) for x in _compact_ids(keep, S))
-        monkeypatch.setenv("TTS_COMPACT", "sort")
-        ids_so, inc_so = (np.asarray(x) for x in _compact_ids(keep, S))
-        assert inc_sc == inc_so == keep.sum()
-        np.testing.assert_array_equal(ids_sc[:inc_sc], ids_so[:inc_so])
+        for mode in ("sort", "search"):
+            monkeypatch.setenv("TTS_COMPACT", mode)
+            ids_x, inc_x = (np.asarray(x) for x in _compact_ids(keep, S))
+            assert inc_sc == inc_x == keep.sum(), mode
+            np.testing.assert_array_equal(ids_sc[:inc_sc], ids_x[:inc_x])
 
 
 def test_compact_knob_parity_end_to_end(monkeypatch):
@@ -211,14 +212,14 @@ def test_compact_knob_parity_end_to_end(monkeypatch):
     ptm = taillard.reduced_instance(14, jobs=9, machines=5)
     opt = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm)).best
     results = {}
-    for mode in ("scatter", "sort"):
+    for mode in ("scatter", "sort", "search"):
         monkeypatch.setenv("TTS_COMPACT", mode)
         res = resident_search(
             PFSPProblem(lb="lb1", ub=0, p_times=ptm), m=8, M=128, K=32,
             initial_best=opt,
         )
         results[mode] = (res.explored_tree, res.explored_sol, res.best)
-    assert results["scatter"] == results["sort"]
+    assert results["scatter"] == results["sort"] == results["search"]
 
 
 def test_compact_knob_flip_rebuilds_program_same_instance(monkeypatch):

@@ -90,10 +90,11 @@ def _compact_ids(keep, S: int):
     for s < tree_inc (the reference's child push order,
     `pfsp_gpu_chpl.chpl:276-298`). Ranks are computed hierarchically (lane
     scan + per-parent prefix) — much cheaper than a flat M*n cumsum. The
-    rank inversion is either a stable argsort of ranked keys (survivors
-    carry their unique rank, non-survivors the max key, so sorted position
-    s holds exactly the rank-s survivor) or one int32-id scatter
-    (``compact_mode``)."""
+    rank inversion is selected by ``compact_mode``: a stable argsort of
+    ranked keys (survivors carry their unique rank, non-survivors the max
+    key, so sorted position s holds exactly the rank-s survivor), a
+    binary-search inverse (parent via searchsorted into the prefix, slot
+    via the lane cumsum), or one int32-id scatter."""
     import jax.numpy as jnp
 
     from ..ops.pfsp_device import compact_mode
@@ -106,9 +107,29 @@ def _compact_ids(keep, S: int):
     tree_inc = offs[-1] + cnt[-1]
     Mn = M * n
     flat = keep.reshape(Mn)
-    if compact_mode() == "sort":
+    mode = compact_mode()
+    if mode == "sort":
         key = jnp.where(flat, ranks.reshape(Mn), jnp.int32(Mn))
         ids = jnp.argsort(key, stable=True)[:S].astype(jnp.int32)
+        return ids, tree_inc
+    if mode == "search":
+        # Binary-search inverse: for output rank s, its parent is the last
+        # p with offs[p] <= s (zero-count parents share the next parent's
+        # offs, so side='right' skips them), and its slot is the lane
+        # whose exclusive cumsum equals the within-parent rank. log2(M)
+        # vectorized gather rounds + one (S, n) lane pass — no scatter, no
+        # sort; rows past tree_inc resolve arbitrarily (dead by the pool
+        # contract) but stay in-bounds via the clips.
+        pos = jnp.arange(S, dtype=jnp.int32)
+        parent = jnp.clip(
+            jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1,
+            0, M - 1,
+        )
+        r = pos - offs[parent]  # within-parent rank
+        krows = keep[parent]  # (S, n)
+        lane_s = lane[parent]  # (S, n) exclusive lane cumsum
+        slot = jnp.argmax((lane_s == r[:, None]) & krows, axis=1)
+        ids = (parent * n + slot).astype(jnp.int32)
         return ids, tree_inc
     flat_idx = jnp.arange(Mn, dtype=jnp.int32)
     # Non-survivors get distinct out-of-bounds destinations so the scatter

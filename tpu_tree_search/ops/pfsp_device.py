@@ -585,7 +585,9 @@ def compact_mode() -> str:
     """``TTS_COMPACT`` selects the stream-compaction implementation baked
     into the resident programs at trace time (`engine/resident.py
     _compact_ids`): ``scatter`` (the original inverse-permutation scatter,
-    default) or ``sort`` (stable argsort of ranked keys). Motivation:
+    default), ``sort`` (stable argsort of ranked keys), or ``search``
+    (binary-search inverse — log2(M) gather rounds, no scatter and no
+    sort). Motivation:
     XLA:TPU lowers large general scatters to a mostly-serial loop (tens of
     ns per index), and the round-5 cycle arithmetic puts the (M*n)-index
     compaction scatter as the dominant non-evaluator cost at every chunk
@@ -599,9 +601,9 @@ def compact_mode() -> str:
     import os
 
     mode = os.environ.get("TTS_COMPACT", "scatter")
-    if mode not in ("scatter", "sort"):
+    if mode not in ("scatter", "sort", "search"):
         raise ValueError(
-            f"TTS_COMPACT must be 'scatter' or 'sort', got {mode!r}"
+            f"TTS_COMPACT must be 'scatter', 'sort', or 'search', got {mode!r}"
         )
     return mode
 
