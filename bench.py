@@ -187,6 +187,7 @@ def record_last_good(record: dict) -> None:
                 "vs_baseline": record["vs_baseline"],
                 "vs_ref_c_seq": record.get("vs_ref_c_seq"),
                 "pallas": record.get("pallas", False),
+                "compact": record.get("compact", {}).get("picked"),
                 "commit": _git_head(),
                 "date": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
             }, f, indent=1)

@@ -90,11 +90,16 @@ def test_probe_pallas_honors_kill_switches(monkeypatch):
 def test_record_last_good_roundtrip(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "lg.json"))
     rec = {"metric": "m", "value": 123.0, "vs_baseline": 1.0,
-           "vs_ref_c_seq": 0.5, "pallas": True}
+           "vs_ref_c_seq": 0.5, "pallas": True,
+           "compact": {"picked": "sort"}}
     bench.record_last_good(rec)
     lg = bench.last_good()
     assert lg["value"] == 123.0 and lg["vs_ref_c_seq"] == 0.5
     assert lg["pallas"] is True and "commit" in lg and "date" in lg
+    assert lg["compact"] == "sort"
+    # A record without the A/B (express mode) stays writable.
+    bench.record_last_good({"metric": "m", "value": 1.0, "vs_baseline": 1.0})
+    assert bench.last_good()["compact"] is None
 
 
 def test_host_seq_parses_partial_rows(monkeypatch):
