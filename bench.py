@@ -555,6 +555,43 @@ def eval_microbench(problem, on_tpu: bool, iters: int | None = None) -> dict:
     }
 
 
+COMPACT_MODES = ("scatter", "sort", "search")
+
+
+def pick_compact(run_fn, parity_fn):
+    """Measure ``run_fn()`` under each compaction mode (TTS_COMPACT) and
+    pick the fastest PARITY-PASSING one (fallback: fastest overall — a
+    fast-but-wrong mode must never displace a clean measurement, but if
+    none is clean the caller's own parity gate reports it). Per-mode
+    failures are recorded, never fatal. Returns ``(stats, best_run)``;
+    ``(None, None)`` if every mode failed to run. Shared by the headline
+    A/B and the N-Queens probe so the mode list and selection rule cannot
+    drift apart."""
+    runs, nps, par, errors = {}, {}, {}, {}
+    for mode in COMPACT_MODES:
+        try:
+            with _env_override("TTS_COMPACT", mode):
+                r = run_fn()
+        except Exception as e:  # noqa: BLE001 — one mode must not kill the rest
+            errors[mode] = f"{type(e).__name__}: {e}"
+            continue
+        runs[mode] = r
+        nps[mode] = round(r[1], 1)
+        par[mode] = bool(parity_fn(r))
+    if not runs:
+        return None, None
+    clean = {k: v for k, v in runs.items() if par[k]}
+    pool = clean or runs
+    pick = max(pool, key=lambda k: pool[k][1])
+    stats = {
+        "picked": pick,
+        "nodes_per_sec": nps,
+        "parity": par,
+        **({"errors": errors} if errors else {}),
+    }
+    return stats, runs[pick]
+
+
 def run_config(problem, m: int, M: int):
     """Warm-up run (compiles) + measured run; returns
     (result, nodes/s, elapsed, device_phase_s)."""
@@ -676,35 +713,21 @@ def main() -> int:
             return run_config(prob_hl, m=25, M=HEADLINE_M)
 
         compact_stats = None
+        best_run = None
         if on_tpu and not express:
             # Empirical compaction pick (cf. the jnp-vs-Pallas pick above):
-            # scatter serializes on TPU, sort loses on CPU — measure both
-            # on the production config, bank the winner, record both. One
+            # scatter serializes on TPU, sort loses on CPU — measure each
+            # on the production config, bank the winner, record all. One
             # problem instance is fine: the program cache keys on the
             # routing token, which includes TTS_COMPACT.
-            runs = {}
-            for mode in ("scatter", "sort", "search"):
-                with _env_override("TTS_COMPACT", mode):
-                    runs[mode] = _headline_run()
-
-            def _run_parity(r) -> bool:
-                return (r[0].explored_tree == GOLDEN_LB1["tree"]
-                        and r[0].explored_sol == GOLDEN_LB1["sol"]
-                        and r[0].best == GOLDEN_LB1["makespan"])
-
-            # Fastest PARITY-PASSING mode: a fast-but-wrong mode must never
-            # displace a clean measurement (the bank gate requires parity).
-            clean = {k: v for k, v in runs.items() if _run_parity(v)}
-            pool_ = clean or runs
-            pick = max(pool_, key=lambda k: pool_[k][1])
-            compact_stats = {
-                "picked": pick,
-                "nodes_per_sec": {
-                    k: round(v[1], 1) for k, v in runs.items()
-                },
-                "parity": {k: _run_parity(v) for k, v in runs.items()},
-            }
-            res, nps, elapsed, device_phase = runs[pick]
+            compact_stats, best_run = pick_compact(
+                _headline_run,
+                lambda r: (r[0].explored_tree == GOLDEN_LB1["tree"]
+                           and r[0].explored_sol == GOLDEN_LB1["sol"]
+                           and r[0].best == GOLDEN_LB1["makespan"]),
+            )
+        if best_run is not None:
+            res, nps, elapsed, device_phase = best_run
         else:
             res, nps, elapsed, device_phase = _headline_run()
         parity = (
@@ -828,7 +851,24 @@ def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
         })
     N = 15 if on_tpu else 12  # CPU smoke stays fast
     try:
-        resq, npsq, _, _ = run_config(NQueensProblem(N=N), m=25, M=65536)
+        # N-Queens cycles are compaction-bound (no pruning: every cycle
+        # compacts a full M*n grid, and XLA:TPU serializes the scatter), so
+        # the compaction mode matters MOST here. The N=15 tree costs ~60s a
+        # run — too dear to A/B directly — so probe the modes on N=14
+        # (~27M nodes) and run N=15 once with the winner; a probe failure
+        # costs the probe, never the N=15 record.
+        import contextlib
+
+        nq_compact = None
+        if on_tpu:
+            nq_compact, _ = pick_compact(
+                lambda: run_config(NQueensProblem(N=14), m=25, M=65536),
+                lambda r: r[0].explored_sol == NQ_SOL[14],
+            )
+        ctx = (_env_override("TTS_COMPACT", nq_compact["picked"])
+               if nq_compact else contextlib.nullcontext())
+        with ctx:
+            resq, npsq, _, _ = run_config(NQueensProblem(N=N), m=25, M=65536)
         extras.append({
             "metric": f"nqueens_n{N}_nodes_per_sec_per_chip",
             "value": round(npsq, 1),
@@ -837,6 +877,7 @@ def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
             "parity": resq.explored_sol == NQ_SOL[N],
             "explored_tree": resq.explored_tree,
             "explored_sol": resq.explored_sol,
+            **({"compact": nq_compact} if nq_compact else {}),
         })
     except Exception as e:  # noqa: BLE001
         extras.append({

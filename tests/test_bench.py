@@ -173,3 +173,32 @@ def test_express_mode_emits_minimal_tpu_gated_line():
     # BENCH_LAST_GOOD.json.
     after = open(lg_path).read() if os.path.exists(lg_path) else None
     assert after == before, "CPU express run clobbered BENCH_LAST_GOOD"
+
+
+def test_pick_compact_selection_rules(monkeypatch):
+    """pick_compact: fastest parity-passing wins; fast-but-wrong falls
+    back to clean; per-mode failures are recorded, not fatal; all-fail
+    returns (None, None)."""
+    calls = []
+
+    def run_fn():
+        import os
+
+        mode = os.environ["TTS_COMPACT"]
+        calls.append(mode)
+        if mode == "search":
+            raise RuntimeError("compile boom")
+        nps = {"scatter": 10.0, "sort": 99.0}[mode]
+        return (object(), nps, 0.0, 0.0)
+
+    stats, best = bench.pick_compact(run_fn, lambda r: r[1] < 50)
+    # sort is fastest but fails parity; scatter is the clean pick.
+    assert stats["picked"] == "scatter" and best[1] == 10.0
+    assert stats["parity"] == {"scatter": True, "sort": False}
+    assert "search" in stats["errors"]
+    assert calls == ["scatter", "sort", "search"]
+
+    def run_fail():
+        raise RuntimeError("no backend")
+
+    assert bench.pick_compact(run_fail, lambda r: True) == (None, None)

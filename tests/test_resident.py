@@ -28,11 +28,15 @@ def test_nqueens_parity():
     )
 
 
-def test_nqueens_overflow_fallback():
+@pytest.mark.parametrize("mode", ["scatter", "sort", "search"])
+def test_nqueens_overflow_fallback(mode, monkeypatch):
     # A warm frontier beyond the fan-out headroom forces the capacity-stall
     # path (host offload cycles until the pool fits again), and M=256 makes
     # breadth chunks exceed the survivor budget (S = M*n/2), covering the
-    # full-scatter overflow branch; counts must not change.
+    # full-scatter overflow branch; counts must not change. Parametrized
+    # over TTS_COMPACT: the overflow branch bypasses the compacted ids, and
+    # every mode must hand over to it identically.
+    monkeypatch.setenv("TTS_COMPACT", mode)
     prob = NQueensProblem(N=11)
     seq = sequential_search(prob)
     res = resident_search(
