@@ -45,6 +45,7 @@ echo "== 6/7 chunk-size sweeps (un-measured configs first) =="
 # banked defaults against drift.
 timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
 TTS_COMPACT=sort timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
+TTS_COMPACT=search timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
 timeout 1200 python scripts/headline_tune.py --quick || true
 timeout 1200 python scripts/lb2_tune.py --quick || true
 # Compaction A/B/C: the serialized-scatter hypothesis says sort- or

@@ -201,4 +201,7 @@ def test_pick_compact_selection_rules(monkeypatch):
     def run_fail():
         raise RuntimeError("no backend")
 
-    assert bench.pick_compact(run_fail, lambda r: True) == (None, None)
+    # All-fail: no best run, but the per-mode diagnostics survive.
+    stats2, best2 = bench.pick_compact(run_fail, lambda r: True)
+    assert best2 is None and stats2["picked"] is None
+    assert set(stats2["errors"]) == set(bench.COMPACT_MODES)
