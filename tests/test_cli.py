@@ -106,3 +106,34 @@ def test_resolve_chunk_size_non_candidates_skip_backend_probe():
     with mock.patch.object(builtins, "__import__", side_effect=guarded):
         assert cli.resolve_chunk_size(None, "nqueens", "seq", "resident") == 50000
         assert cli.resolve_chunk_size(None, "pfsp", "device", "offload") == 50000
+
+
+def test_compact_flag_pins_env_and_is_recorded(capsys, monkeypatch):
+    """--compact must pin TTS_COMPACT for the run (restoring afterwards —
+    two main() calls in one process must not leak the pin) and the JSON
+    record must name the active mode (so a stats line proves which
+    compaction ran); tiers whose engine never compacts carry no key and
+    reject the flag."""
+    import os
+
+    monkeypatch.delenv("TTS_COMPACT", raising=False)
+    cli.main(["nqueens", "--N", "8", "--tier", "device", "--M", "64",
+              "--compact", "sort", "--json"])
+    rec = _last_json(capsys.readouterr().out)
+    assert rec["compact"] == "sort"
+    assert rec["explored_sol"] == 92  # N=8 golden
+    assert "TTS_COMPACT" not in os.environ  # pin restored, not leaked
+
+    cli.main(["nqueens", "--N", "8", "--tier", "device", "--M", "64",
+              "--json"])
+    rec2 = _last_json(capsys.readouterr().out)
+    assert rec2["compact"] == "scatter"  # default, not the prior run's pin
+
+    # Offload/seq runs never compact: no flag, no key.
+    with pytest.raises(SystemExit) as e:
+        cli.main(["nqueens", "--N", "8", "--tier", "device",
+                  "--engine", "offload", "--compact", "sort"])
+    assert e.value.code == 2
+    cli.main(["nqueens", "--N", "8", "--tier", "device",
+              "--engine", "offload", "--M", "64", "--json"])
+    assert "compact" not in _last_json(capsys.readouterr().out)

@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="mesh tier, PFSP lb2 only: shard the Johnson machine-pair "
         "loop over a second mesh axis of this size (dp x mp devices)",
     )
+    common.add_argument(
+        "--compact", choices=["scatter", "sort", "search"], default=None,
+        help="stream-compaction implementation for the device tiers "
+        "(default: TTS_COMPACT env or 'scatter'; the three are "
+        "bit-identical — pick by measurement, see bench.py's per-run A/B)",
+    )
     common.add_argument("--stats-file", type=str, default=None,
                         help="append one result line to this .dat file")
     common.add_argument("--json", action="store_true", help="emit one JSON result line")
@@ -133,6 +139,12 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
             "--engine offload is not available for this tier "
             "(mesh/dist_mesh are resident-only; use --tier multi for "
             "host-orchestrated offload across devices)"
+        )
+    if args.compact is not None and not uses_compaction(args):
+        parser.error(
+            "--compact only applies to runs with device-side compaction "
+            "(--tier device with the resident engine, mesh, dist_mesh); "
+            "the offload/multi/dist workers prune on host"
         )
     if args.perc != 0.5 and args.tier not in ("multi", "dist"):
         parser.error(
@@ -220,9 +232,37 @@ def resolve_chunk_size(M, problem_name: str, tier: str, engine: str,
     return 1024 if backend == "tpu" else 50000
 
 
+def uses_compaction(args) -> bool:
+    """True for runs whose engine performs device-side stream compaction
+    (`engine/resident.py _compact_ids`): the resident device engine and
+    the mesh-resident tiers. The offload/multi/dist workers prune and
+    branch on host and never consult TTS_COMPACT."""
+    return (args.tier in ("mesh", "dist_mesh")
+            or (args.tier == "device" and args.engine == "resident"))
+
+
 def run_tier(problem, args):
     args.M = resolve_chunk_size(args.M, getattr(problem, "name", ""),
                                 args.tier, args.engine)
+    if args.compact is not None:
+        import os
+
+        # Flag > env for THIS run only: restore on exit so a caller
+        # invoking main() twice in one process does not inherit the pin
+        # (programs cache per mode via the routing token).
+        prev = os.environ.get("TTS_COMPACT")
+        os.environ["TTS_COMPACT"] = args.compact
+        try:
+            return _dispatch_tier(problem, args)
+        finally:
+            if prev is None:
+                os.environ.pop("TTS_COMPACT", None)
+            else:
+                os.environ["TTS_COMPACT"] = prev
+    return _dispatch_tier(problem, args)
+
+
+def _dispatch_tier(problem, args):
     ckpt_kw = dict(
         max_steps=args.max_steps,
         checkpoint_path=args.checkpoint,
@@ -405,6 +445,14 @@ def result_record(args, res) -> dict:
         from .ops import pallas_kernels as PK
 
         rec["pallas"] = PK.use_pallas()
+        if uses_compaction(args):
+            # args.compact first: run_tier restores the env pin before this
+            # record is built. Runs whose engine never compacts carry no
+            # "compact" key at all — a stats line must not claim a mode the
+            # run did not use.
+            from .ops.pfsp_device import compact_mode
+
+            rec["compact"] = args.compact or compact_mode()
         if args.problem == "pfsp" and args.lb == "lb2":
             # Staging applies at every mp: under mp > 1 the compacted self
             # bound shards its pair loop with a pmax combine. The job count
