@@ -205,3 +205,19 @@ def test_pick_compact_selection_rules(monkeypatch):
     stats2, best2 = bench.pick_compact(run_fail, lambda r: True)
     assert best2 is None and stats2["picked"] is None
     assert set(stats2["errors"]) == set(bench.COMPACT_MODES)
+
+
+def test_pick_compact_budget_skips_but_always_runs_first(monkeypatch):
+    """The budget bounds total A/B wall time: the first mode always runs
+    (the old single-mode floor), later modes are skipped and recorded."""
+    import itertools
+
+    t = itertools.count()
+    monkeypatch.setattr(bench.time, "monotonic", lambda: next(t) * 100.0)
+
+    def run_fn():
+        return (object(), 5.0, 0.0, 0.0)
+
+    stats, best = bench.pick_compact(run_fn, lambda r: True, budget_s=50.0)
+    assert best is not None and stats["picked"] == "scatter"
+    assert stats["skipped_budget"] == ["sort", "search"]
