@@ -252,3 +252,28 @@ def test_large_instance_kernels_compile_on_tpu(inst, lb, B):
             t.pairs, t.lags, t.johnson_schedules, bf16=t.exact_bf16,
         ))
     np.testing.assert_array_equal(got[open_], ref[open_])
+
+
+@pytest.mark.parametrize("mode", ["scatter", "sort", "search"])
+def test_compact_modes_on_tpu(mode, monkeypatch):
+    """All three TTS_COMPACT rank inversions through the real XLA:TPU
+    lowering (sort/search are plain XLA ops — no Mosaic — but their TPU
+    lowerings must produce the same exact counts the CPU suite pins; the
+    scatter row doubles as the serialized-scatter baseline)."""
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.engine.sequential import sequential_search
+    from tpu_tree_search.problems import PFSPProblem
+    from tpu_tree_search.problems.pfsp import taillard
+
+    monkeypatch.setenv("TTS_COMPACT", mode)
+    ptm = taillard.reduced_instance(14, jobs=10, machines=5)
+    opt = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm)).best
+    seq = sequential_search(
+        PFSPProblem(lb="lb1", ub=0, p_times=ptm), initial_best=opt
+    )
+    res = resident_search(
+        PFSPProblem(lb="lb1", ub=0, p_times=ptm), m=8, M=128, initial_best=opt
+    )
+    assert (res.explored_tree, res.explored_sol, res.best) == (
+        seq.explored_tree, seq.explored_sol, opt
+    )
