@@ -1,0 +1,49 @@
+"""Known-bad fixture: unlocked access to guarded-by annotated state."""
+
+import threading
+
+
+class Counter:
+    # guarded-by: lock -- value, history
+    # requires-lock: lock -- bump_unlocked
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.value = 0
+        self.history: list[int] = []
+
+    def bump(self):
+        with self.lock:
+            self.value += 1  # OK: under the matching lock
+
+    def bump_unlocked(self):
+        self.value += 1  # OK: requires-lock contract (call sites checked)
+
+    def try_lock(self) -> bool:
+        return self.lock.acquire(blocking=False)
+
+    def unlock(self):
+        self.lock.release()
+
+    def peek(self) -> int:
+        return self.value  # BAD: unlocked read in a non-contract method
+
+
+def race(counters: list[Counter]):
+    c = counters[0]
+    c.value += 1  # BAD: unlocked write through a typed base
+    c.bump_unlocked()  # BAD: requires-lock call without the lock
+    with c.lock:
+        c.value += 1  # OK
+        c.bump_unlocked()  # OK
+    if c.try_lock():
+        c.value -= 1  # OK: try_lock taken branch
+    for other in counters:
+        other.history.append(1)  # BAD: unlocked read of guarded field
+    big = max(counters)
+    return big.value  # BAD: unlocked read via min/max element inference
+
+
+def waived(c: Counter) -> int:
+    # tts-lint: waive guarded-by -- advisory racy read, re-checked under lock
+    return c.value

@@ -1,0 +1,44 @@
+"""Known-bad fixture: host syncs reachable inside traced code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def decorated_step(pool, size):
+    total = pool.sum()
+    return total.item()  # BAD: .item() inside jit
+
+
+def helper(x):
+    return float(x) + 1.0  # BAD via call closure: float() on traced arg
+
+
+def body(carry):
+    x, i = carry
+    np.asarray(x)  # BAD: np.asarray inside while_loop body
+    return helper(x), i + 1
+
+
+def cond(carry):
+    return carry[1] < 10
+
+
+def run(x):
+    return lax.while_loop(cond, body, (x, 0))
+
+
+def bound_step(pool, best):
+    jax.device_get(best)  # BAD: device_get in jitted fn
+    pool.block_until_ready()  # BAD: sync in jitted fn
+    return pool.min(best)
+
+
+run_jit = jax.jit(bound_step, donate_argnums=(0,))
+
+
+# tts-lint: traced
+def marked(frontier):
+    return int(frontier[0])  # BAD: int() on traced value (marker form)

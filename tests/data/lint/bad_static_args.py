@@ -1,0 +1,31 @@
+"""Known-bad fixture: Python-scalar params of jitted entry points not
+declared static."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated(pool, m: int, flip: bool):  # BAD x2: m, flip dynamic
+    return pool[:1] if flip else pool
+
+
+@partial(jax.jit, static_argnames=("m",))
+def partial_ok(pool, m: int):  # OK: m declared static
+    return pool * m
+
+
+def stepper(pool, k: int, best):  # BAD: k dynamic at the jit call site
+    return pool + k + best
+
+
+step = jax.jit(stepper, donate_argnums=(0,))
+
+
+def clean(pool, best):  # OK: no scalar-annotated params
+    return jnp.minimum(pool, best)
+
+
+clean_jit = jax.jit(clean)

@@ -1,0 +1,30 @@
+"""Known-bad fixture: Python control flow on traced values."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def prune(bounds, best):
+    if bounds.min() < best:  # BAD: `if` on traced comparison
+        best = bounds.min()
+    n = 0
+    while best > 0:  # BAD: `while` on traced value
+        n += 1
+    size = bounds.shape[0]
+    if size > 128:  # OK: shape metadata is static
+        return best
+    return jnp.minimum(best, 0)
+
+
+def kernel(x, flag):
+    y = x * 2
+    z = y + 1
+    if z.sum() > 0:  # BAD: derived traced value (assignment chain)
+        return z
+    if flag is None:  # OK: identity check is static
+        return x
+    return y
+
+
+wrapped = jax.jit(kernel)

@@ -1,0 +1,49 @@
+"""Known-good fixture: device-idiomatic code that must produce no findings."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def step(pool, size, best):
+    cnt = jnp.minimum(size, 8)
+    bounds = pool[:8].sum(axis=-1)
+    best = jnp.minimum(best, jnp.min(bounds))
+    keep = bounds < best
+    return pool, size - cnt, best, keep
+
+
+def host_driver(pool_np, best: int):
+    # Host-side code may sync freely: none of this is traced.
+    arr = np.asarray(pool_np)
+    total = int(arr.sum())
+    if total > 0:
+        best = min(best, total)
+    return float(best)
+
+
+class Pool:
+    # guarded-by: lock -- size
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.size = 0
+
+
+def consume(p: Pool) -> int:
+    with p.lock:
+        return p.size
+
+
+def shapes(x):
+    n = x.shape[-1]
+    if n <= 32:  # static: shape metadata
+        return x.reshape(n, -1)
+    return x
+
+
+shaped = jax.jit(shapes)

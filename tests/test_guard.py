@@ -1,0 +1,144 @@
+"""Runtime guard mode (TTS_GUARD / --guard): steady-state resident cycles
+must neither recompile nor transfer (ISSUE 1 acceptance criterion)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.analysis.guard import (
+    GuardViolation,
+    SteadyStateGuard,
+    guard_enabled,
+)
+from tpu_tree_search.engine import sequential_search
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.problems import NQueensProblem
+
+
+def test_guard_enabled_resolution(monkeypatch):
+    monkeypatch.delenv("TTS_GUARD", raising=False)
+    assert guard_enabled(None) is False
+    assert guard_enabled(True) is True
+    monkeypatch.setenv("TTS_GUARD", "1")
+    assert guard_enabled(None) is True
+    assert guard_enabled(False) is False  # explicit flag wins
+    monkeypatch.setenv("TTS_GUARD", "0")
+    assert guard_enabled(None) is False
+
+
+# -- unit: the guard actually catches violations ---------------------------
+
+
+def test_guard_catches_recompile():
+    import jax
+    import jax.numpy as jnp
+
+    # No embedded constants: a recompile must be caught by the cache-size
+    # assertion itself, not by the constant-upload transfer it may cause.
+    f = jax.jit(lambda x: x + x)
+    x4 = jnp.ones((4,))
+    x8 = jnp.ones((8,))  # device arrays built OUTSIDE the guarded dispatch
+    g = SteadyStateGuard(f, "test step")
+    with g.step():
+        f(x4)  # warm
+    with g.step():
+        f(x4)  # steady state, cached
+    with pytest.raises(GuardViolation, match="recompiled"):
+        with g.step():
+            f(x8)  # new shape -> new executable
+
+
+def test_guard_catches_implicit_transfer():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    g = SteadyStateGuard(f, "test step")
+    with g.step():
+        f(jnp.ones((4,)))
+    with pytest.raises(GuardViolation, match="implicit transfer"):
+        with g.step():
+            # np operand: implicit host->device upload inside the guarded
+            # dispatch (exactly the regression the guard exists to catch)
+            f(np.ones((4,), np.float32))
+
+
+def test_guard_rearm_accepts_new_warm_dispatch():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x - x)
+    x4, x16 = jnp.ones((4,)), jnp.ones((16,))
+    g = SteadyStateGuard(f, "test step")
+    with g.step():
+        f(x4)
+    g.rearm()
+    with g.step():  # warm again: recompile is sanctioned
+        f(x16)
+    with g.step():
+        f(x16)
+
+
+def test_guard_disabled_is_noop():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    g = SteadyStateGuard(f, "test step", enabled=False)
+    for shape in ((4,), (8,), (16,)):  # would violate if enabled
+        with g.step():
+            f(np.ones(shape, np.float32))
+    assert g.steps == 0
+
+
+# -- the acceptance-criterion run -----------------------------------------
+
+
+def test_resident_steady_state_is_pure_under_guard():
+    """N > 1 steady-state cycles with zero recompilations and zero implicit
+    transfers: a guarded resident run completes (any violation raises) and
+    provably dispatched more than one K-block."""
+    p = NQueensProblem(N=9)
+    res = resident_search(p, m=25, M=128, K=2, guard=True)
+    assert res.complete
+    # kernel_launches counts device chunk cycles; > K proves more than one
+    # host dispatch ran, i.e. the guarded steady-state path was exercised.
+    assert res.diagnostics.kernel_launches > 2
+    seq = sequential_search(NQueensProblem(N=9))
+    assert res.explored_tree == seq.explored_tree
+    assert res.explored_sol == seq.explored_sol
+
+
+def test_resident_guard_env_knob(monkeypatch):
+    monkeypatch.setenv("TTS_GUARD", "1")
+    res = resident_search(NQueensProblem(N=8), m=25, M=64, K=2)
+    assert res.complete and res.diagnostics.kernel_launches > 2
+
+
+def test_mesh_resident_guard():
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("mesh tier needs jax.shard_map (not in this jax build; "
+                    "the whole mesh tier skips/fails on it in the seed)")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device CPU platform")
+    from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
+
+    res = mesh_resident_search(
+        NQueensProblem(N=9), m=5, M=64, K=2, D=2, guard=True
+    )
+    assert res.complete
+    seq = sequential_search(NQueensProblem(N=9))
+    assert res.explored_sol == seq.explored_sol
+
+
+def test_cli_guard_flag_rejected_off_resident_tiers():
+    from tpu_tree_search import cli
+
+    with pytest.raises(SystemExit):
+        cli.main(["nqueens", "--N", "8", "--tier", "seq", "--guard"])
+    with pytest.raises(SystemExit):
+        cli.main(["nqueens", "--N", "8", "--tier", "device",
+                  "--engine", "offload", "--guard"])

@@ -1,0 +1,208 @@
+"""Unit tests for the `tts lint` static-analysis framework (ISSUE 1).
+
+Fixture-based: each rule has a known-bad snippet under tests/data/lint/
+that must produce its findings at the expected lines, and a known-good
+snippet that must stay silent. The repo itself must lint clean against the
+committed baseline — with *empty* cells for the resident hot paths."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import tpu_tree_search
+from tpu_tree_search import cli
+from tpu_tree_search.analysis import DEFAULT_BASELINE, lint
+from tpu_tree_search.analysis.baseline import load_baseline, ratchet, save_baseline
+from tpu_tree_search.analysis.core import RULES
+
+FIXTURES = Path(__file__).parent / "data" / "lint"
+PKG = Path(tpu_tree_search.__file__).parent
+REPO = PKG.parent
+
+
+def findings_of(path, rule=None):
+    res = lint([str(path)])
+    out = res["new"]
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+def test_all_four_rules_registered():
+    assert {"host-sync-in-jit", "tracer-branch", "guarded-by",
+            "static-arg-hygiene"} <= set(RULES)
+
+
+# -- host-sync-in-jit ------------------------------------------------------
+
+
+def test_host_sync_bad_fixture():
+    fs = findings_of(FIXTURES / "bad_host_sync.py", "host-sync-in-jit")
+    lines = sorted(f.line for f in fs)
+    # .item() in a decorated jit; float() via call closure; np.asarray in a
+    # while_loop body; device_get + block_until_ready in a jit-bound fn;
+    # int() in a marker-annotated traced fn.
+    assert lines == [12, 16, 21, 34, 35, 44]
+    msgs = " ".join(f.message for f in fs)
+    assert ".item()" in msgs and "numpy.asarray" in msgs
+    assert "jax.device_get" in msgs and ".block_until_ready()" in msgs
+
+
+# -- tracer-branch ---------------------------------------------------------
+
+
+def test_tracer_branch_bad_fixture():
+    fs = findings_of(FIXTURES / "bad_tracer_branch.py", "tracer-branch")
+    lines = sorted(f.line for f in fs)
+    assert lines == [9, 12, 23]
+    # the static-shape `if` (line 15) and the `is None` check (line 26)
+    # must NOT be flagged
+    assert 15 not in lines and 26 not in lines
+
+
+# -- guarded-by ------------------------------------------------------------
+
+
+def test_guarded_by_bad_fixture():
+    fs = findings_of(FIXTURES / "bad_guarded_by.py", "guarded-by")
+    lines = sorted(f.line for f in fs)
+    assert lines == [29, 34, 35, 42, 44]
+
+
+def test_guarded_by_waiver_honored():
+    res = lint([str(FIXTURES / "bad_guarded_by.py")])
+    waived = [f for f in res["waived"] if f.rule == "guarded-by"]
+    assert len(waived) == 1 and waived[0].line == 49
+
+
+# -- static-arg-hygiene ----------------------------------------------------
+
+
+def test_static_arg_bad_fixture():
+    fs = findings_of(FIXTURES / "bad_static_args.py", "static-arg-hygiene")
+    assert len(fs) == 3
+    msgs = " ".join(f.message for f in fs)
+    assert "'m'" in msgs and "'flip'" in msgs and "'k'" in msgs
+    # the declared-static param must not be flagged
+    assert "partial_ok" not in msgs
+
+
+# -- known-good fixture ----------------------------------------------------
+
+
+def test_good_fixture_is_clean():
+    assert findings_of(FIXTURES / "good_clean.py") == []
+
+
+# -- waiver format ---------------------------------------------------------
+
+
+def test_waiver_without_reason_is_a_finding(tmp_path):
+    f = tmp_path / "w.py"
+    f.write_text(
+        "import threading\n\n\n"
+        "class C:\n"
+        "    # guarded-by: lock -- x\n"
+        "    def __init__(self):\n"
+        "        self.lock = threading.Lock()\n"
+        "        self.x = 0\n\n\n"
+        "def f(c: C):\n"
+        "    # tts-lint: waive guarded-by\n"
+        "    return c.x\n"
+    )
+    res = lint([str(f)])
+    rules = {x.rule for x in res["new"]}
+    # the reasonless waiver is flagged AND does not suppress the finding
+    assert "waiver-format" in rules and "guarded-by" in rules
+
+
+# -- baseline ratchet ------------------------------------------------------
+
+
+def test_baseline_ratchet(tmp_path):
+    bad = FIXTURES / "bad_tracer_branch.py"
+    res = lint([str(bad)])
+    assert len(res["new"]) == 3
+    bl = tmp_path / "bl.json"
+    save_baseline(str(bl), res["new"])
+    counts = load_baseline(str(bl))
+    res2 = lint([str(bad)], counts)
+    assert res2["new"] == [] and len(res2["baselined"]) == 3
+    # shrinking the accepted count resurfaces the whole cell
+    cell = next(iter(counts))
+    counts[cell] -= 1
+    new, old = ratchet(res["new"], counts)
+    assert len(new) == 3 and old == []
+
+
+def test_repo_lints_clean_with_committed_baseline():
+    baseline = load_baseline(str(REPO / DEFAULT_BASELINE))
+    res = lint([str(PKG)], baseline)
+    assert res["new"] == [], "\n".join(f.render() for f in res["new"])
+
+
+def test_hot_path_baseline_cells_are_empty():
+    """ISSUE 1 satellite: engine/resident.py and parallel/resident_mesh.py
+    must lint clean with NO baseline debt."""
+    counts = load_baseline(str(REPO / DEFAULT_BASELINE))
+    dirty = [
+        cell for cell in counts
+        if "engine/resident.py" in cell or "parallel/resident_mesh.py" in cell
+    ]
+    assert dirty == []
+
+
+# -- CLI surfaces ----------------------------------------------------------
+
+
+def test_cli_lint_bad_fixture_nonzero():
+    rc = cli.main(["lint", "--no-baseline",
+                   str(FIXTURES / "bad_host_sync.py")])
+    assert rc == 1
+
+
+def test_cli_lint_repo_zero(monkeypatch):
+    monkeypatch.chdir(REPO)
+    assert cli.main(["lint"]) == 0
+
+
+def test_cli_lint_json(capsys):
+    rc = cli.main(["lint", "--no-baseline", "--json",
+                   str(FIXTURES / "bad_static_args.py")])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["new"]) == 3
+
+
+def test_module_entry_point():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_tree_search.analysis", "--no-baseline",
+         str(FIXTURES / "bad_guarded_by.py")],
+        capture_output=True, text=True,
+        cwd=str(REPO), env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1
+    assert "guarded-by" in proc.stdout
+
+
+def test_update_baseline_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    bad = FIXTURES / "bad_tracer_branch.py"
+    bl = tmp_path / "bl.json"
+    assert cli.main(["lint", "--baseline", str(bl), "--update-baseline",
+                     str(bad)]) == 0
+    assert cli.main(["lint", "--baseline", str(bl), str(bad)]) == 0
+
+
+@pytest.mark.parametrize("rule", ["host-sync-in-jit", "tracer-branch",
+                                  "guarded-by", "static-arg-hygiene"])
+def test_rule_selection(rule):
+    res = lint([str(FIXTURES)], rules=[rule])
+    assert all(f.rule in (rule, "waiver-format") for f in res["new"])
+    assert any(f.rule == rule for f in res["new"])

@@ -1,0 +1,126 @@
+"""``tpu_tree_search.analysis`` — JAX-aware static analysis (``tts lint``)
+and runtime guards for the search engines.
+
+Static side: a pluggable AST-pass framework (``core``), four rules —
+``host-sync-in-jit``, ``tracer-branch``, ``guarded-by``,
+``static-arg-hygiene`` (``jax_rules`` / ``locks``) — inline waivers and a
+committed count-ratchet baseline (``baseline``). Runtime side: the
+``TTS_GUARD=1`` steady-state transfer/recompile guard (``guard``). See
+docs/ANALYSIS.md for the rule catalogue and annotation grammar.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from .baseline import (
+    apply_waivers,
+    load_baseline,
+    ratchet,
+    save_baseline,
+)
+from .core import RULES, Finding, parse_modules, run_rules
+from .guard import GuardViolation, SteadyStateGuard, guard_enabled
+
+# Rule modules register themselves into RULES at import time.
+from . import jax_rules as _jax_rules  # noqa: E402,F401  (registration)
+from . import locks as _locks  # noqa: E402,F401  (registration)
+
+__all__ = [
+    "Finding",
+    "GuardViolation",
+    "RULES",
+    "SteadyStateGuard",
+    "add_lint_args",
+    "guard_enabled",
+    "lint",
+    "lint_main",
+    "run_lint_cli",
+]
+
+DEFAULT_BASELINE = ".tts-lint-baseline.json"
+
+
+def lint(paths, baseline: dict[str, int] | None = None,
+         rules=None) -> dict[str, list[Finding]]:
+    """Run the analysis; returns findings split into ``new`` (fail the
+    build), ``baselined`` (accepted debt) and ``waived`` (inline-justified).
+    """
+    modules, parse_errors = parse_modules(paths)
+    findings = run_rules(modules, only=rules)
+    active, waived = apply_waivers(modules, findings)
+    active = parse_errors + active
+    new, old = ratchet(active, baseline or {})
+    return {"new": new, "baselined": old, "waived": waived}
+
+
+def _default_paths() -> list[str]:
+    # Repo checkout first; fall back to the installed package so
+    # `tts lint` works from anywhere.
+    if os.path.isdir("tpu_tree_search"):
+        return ["tpu_tree_search"]
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def add_lint_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: the package)")
+    p.add_argument("--baseline", default=None,
+                   help=f"ratchet file (default: ./{DEFAULT_BASELINE} "
+                        "when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: report ALL findings")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to the current finding set")
+    p.add_argument("--rule", action="append", default=None, dest="rules",
+                   metavar="NAME", help="run only this rule (repeatable)")
+    p.add_argument("--json", action="store_true", dest="lint_json",
+                   help="emit one JSON object instead of text")
+    p.add_argument("--show-waived", action="store_true",
+                   help="also list waived findings")
+
+
+def run_lint_cli(args) -> int:
+    paths = args.paths or _default_paths()
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    res = lint(paths, baseline, rules=args.rules)
+    if args.update_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        save_baseline(target, res["new"] + res["baselined"])
+        print(f"baseline written: {target} "
+              f"({len(res['new']) + len(res['baselined'])} finding(s))")
+        return 0
+    if args.lint_json:
+        print(json.dumps({
+            k: [vars(f) for f in v] for k, v in res.items()
+        }))
+        return 1 if res["new"] else 0
+    for f in res["new"]:
+        print(f.render())
+    if args.show_waived:
+        for f in res["waived"]:
+            print(f"{f.render()}  (waived)")
+    n_new, n_old, n_waived = (
+        len(res["new"]), len(res["baselined"]), len(res["waived"])
+    )
+    print(
+        f"tts lint: {n_new} new finding(s), {n_old} baselined, "
+        f"{n_waived} waived"
+    )
+    return 1 if res["new"] else 0
+
+
+def lint_main(argv=None) -> int:
+    """`python -m tpu_tree_search.analysis` entry point."""
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_tree_search.analysis",
+        description="JAX-aware static analysis for tpu_tree_search "
+                    "(see docs/ANALYSIS.md)",
+    )
+    add_lint_args(p)
+    return run_lint_cli(p.parse_args(argv))

@@ -1,0 +1,10 @@
+"""``python -m tpu_tree_search.analysis`` — standalone lint entry point
+(the ``tts lint`` subcommand without the rest of the CLI; usable in CI
+before the package's heavy deps are importable)."""
+
+import sys
+
+from . import lint_main
+
+if __name__ == "__main__":
+    sys.exit(lint_main())

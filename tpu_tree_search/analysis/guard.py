@@ -1,0 +1,110 @@
+"""Runtime trace/transfer guards for the resident engines (``TTS_GUARD=1``).
+
+The static rules prove properties of the *source*; this module asserts the
+complementary *runtime* invariant: once a resident engine reaches steady
+state, every host dispatch of the compiled step must reuse the cached XLA
+executable (zero recompilations) and move zero bytes implicitly between
+host and device — the search advances purely on-device, and the host reads
+back only the sanctioned counter scalars between K-cycle blocks.
+
+Usage: the engine wraps each dispatch in ``SteadyStateGuard.step()``. The
+first dispatch is the warm one (compilation + constant upload are expected
+and excluded); every later dispatch runs under
+``jax.transfer_guard("disallow")`` and is followed by a jit-cache-size
+check. A violation raises ``GuardViolation`` naming the step — failing
+loudly at the moment a perf regression re-introduces a per-cycle host
+round trip (~360 ms each, docs/HW_VALIDATION.md) instead of silently
+running 700x slower.
+
+Backend note: the transfer guard catches implicit host->device transfers on
+every backend; implicit device->host reads are reliably caught on
+accelerator backends (on CPU the "device" buffer aliases host memory and
+jax does not count the read as a transfer). The compilation-count assertion
+is backend-independent.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+
+class GuardViolation(RuntimeError):
+    """A steady-state resident dispatch recompiled or transferred."""
+
+
+def guard_enabled(flag: bool | None = None) -> bool:
+    """Explicit flag wins; else the TTS_GUARD env knob (``--guard`` in the
+    CLI pins it for the run)."""
+    if flag is not None:
+        return flag
+    return os.environ.get("TTS_GUARD", "0") not in ("", "0")
+
+
+def _cache_size(jitted) -> int | None:
+    fn = getattr(jitted, "_cache_size", None)
+    if fn is None:
+        return None
+    try:
+        return int(fn())
+    except Exception:
+        return None
+
+
+class SteadyStateGuard:
+    """Wraps a jitted step's dispatches; asserts steady-state purity.
+
+    ``enabled=False`` collapses to a no-op so engines can install it
+    unconditionally and keep one code path.
+    """
+
+    def __init__(self, jitted, label: str = "resident step",
+                 enabled: bool = True):
+        self.jitted = jitted
+        self.label = label
+        self.enabled = enabled
+        self.steps = 0  # dispatches seen (first one is the warm dispatch)
+        self._warm_cache: int | None = None
+
+    @contextmanager
+    def step(self):
+        if not self.enabled:
+            yield
+            return
+        if self.steps == 0:
+            # Warm dispatch: compilation + table/constant upload expected.
+            yield
+            self.steps += 1
+            self._warm_cache = _cache_size(self.jitted)
+            return
+        import jax
+
+        try:
+            with jax.transfer_guard("disallow"):
+                yield
+        except Exception as e:
+            if "isallowed" in str(e):  # jaxlib "Disallowed ... transfer"
+                raise GuardViolation(
+                    f"{self.label}: implicit transfer in steady-state "
+                    f"dispatch {self.steps + 1}: {e}"
+                ) from e
+            raise
+        self.steps += 1
+        size = _cache_size(self.jitted)
+        if (
+            self._warm_cache is not None
+            and size is not None
+            and size > self._warm_cache
+        ):
+            raise GuardViolation(
+                f"{self.label}: steady-state dispatch {self.steps} "
+                f"recompiled (jit cache grew {self._warm_cache} -> {size}); "
+                "a shape/dtype/static-arg is varying between dispatches"
+            )
+
+    def rearm(self) -> None:
+        """Accept the next dispatch as a new warm one (engines call this
+        after a sanctioned re-initialization, e.g. the capacity-stall
+        offload fallback re-uploading a rebuilt pool)."""
+        self.steps = 0
+        self._warm_cache = None

@@ -1,0 +1,449 @@
+"""JAX-aware rules: host-sync-in-jit, tracer-branch, static-arg-hygiene.
+
+All three share one per-module analysis: the set of *traced functions* —
+functions whose bodies execute under a JAX trace. A function is a traced
+root when it is
+
+  * decorated with a jit-like transform (``@jax.jit``,
+    ``@partial(jax.jit, ...)``),
+  * passed by name (or as a lambda) to a trace entry point
+    (``jax.jit(step, ...)``, ``lax.while_loop(cond, body, init)``,
+    ``lax.cond(p, a, b, ...)``, ``jax.shard_map(f, ...)``,
+    ``pl.pallas_call(kernel, ...)`` …), or
+  * explicitly marked ``# tts-lint: traced`` — the escape hatch for closures
+    returned through an indirection the resolver cannot follow (e.g. the
+    resident engine's ``loop_fns`` returning ``(cond, body)``).
+
+Tracedness then closes over *statically resolvable local calls*: a local
+function called from a traced body is traced too. The resolver is lexical
+(same module, innermost scope outward) — cross-module calls are out of
+scope by design; annotate the callee's module instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PRAGMA, Finding, Module, Project, rule
+
+#: Final attribute names of jax entry points that trace function arguments.
+TRACE_ENTRIES = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "jacfwd", "jacrev",
+    "hessian", "shard_map", "while_loop", "fori_loop", "scan", "cond",
+    "switch", "associative_scan", "pallas_call", "checkpoint", "remat",
+    "custom_jvp", "custom_vjp",
+}
+
+#: Method calls that synchronize with / copy to the host.
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "to_py"}
+
+#: Qualified calls that materialize device values on host.
+HOST_SYNC_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray", "numpy.copy",
+    "jax.device_get",
+}
+
+
+def _is_trace_entry(module: Module, call: ast.Call) -> bool:
+    qual = module.qualname(call.func)
+    if qual is None:
+        return False
+    parts = qual.split(".")
+    return parts[-1] in TRACE_ENTRIES and parts[0] == "jax"
+
+
+def _partial_trace_entry(module: Module, call: ast.Call) -> bool:
+    """``partial(jax.jit, ...)`` used as a decorator/factory."""
+    qual = module.qualname(call.func)
+    if qual not in ("functools.partial", "partial"):
+        return False
+    return bool(call.args) and _is_entry_ref(module, call.args[0])
+
+
+def _is_entry_ref(module: Module, node: ast.AST) -> bool:
+    if not isinstance(node, (ast.Name, ast.Attribute)):
+        return False
+    qual = module.qualname(node)
+    if qual is None:
+        return False
+    parts = qual.split(".")
+    return parts[-1] in TRACE_ENTRIES and parts[0] == "jax"
+
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class _Scopes:
+    """Lexical function-name resolution for one module."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        # nearest enclosing function of every def (None = module level)
+        self.owner: dict[ast.AST, ast.AST | None] = {}
+        # scope -> {name: def_node}
+        self.defs: dict[ast.AST | None, dict[str, ast.AST]] = {None: {}}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                owner = module.enclosing_function(node)
+                self.owner[node] = owner
+                self.defs.setdefault(owner, {})[node.name] = node
+
+    def resolve(self, at: ast.AST, name: str) -> ast.AST | None:
+        """Innermost-scope-outward lookup of a function name."""
+        scope = self.module.enclosing_function(at)
+        while True:
+            found = self.defs.get(scope, {}).get(name)
+            if found is not None:
+                return found
+            if scope is None:
+                return None
+            scope = self.module.enclosing_function(scope)
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function's body without descending into nested functions
+    (nested defs get their own walk once proven traced)."""
+    body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FunctionNode):
+                yield child  # the def itself (for call-closure), not its body
+                continue
+            stack.append(child)
+
+
+def _has_marker(module: Module, fn: ast.AST) -> bool:
+    if isinstance(fn, ast.Lambda):
+        return False
+    for line in (fn.lineno, fn.lineno - 1):
+        comment = module.comments.get(line, "")
+        if PRAGMA in comment and "traced" in comment.split(PRAGMA, 1)[-1]:
+            return True
+    return False
+
+
+def traced_functions(module: Module, project: Project) -> set[ast.AST]:
+    """The per-module set of function nodes whose bodies run under trace."""
+
+    def build(_):
+        scopes = _Scopes(module)
+        roots: set[ast.AST] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_entry_ref(module, dec):
+                        roots.add(node)
+                    elif isinstance(dec, ast.Call) and (
+                        _is_trace_entry(module, dec)
+                        or _partial_trace_entry(module, dec)
+                    ):
+                        roots.add(node)
+                if _has_marker(module, node):
+                    roots.add(node)
+            elif isinstance(node, ast.Call) and _is_trace_entry(module, node):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        roots.add(arg)
+                    elif isinstance(arg, ast.Name):
+                        target = scopes.resolve(node, arg.id)
+                        if target is not None:
+                            roots.add(target)
+        # Close over statically resolvable local calls.
+        traced = set()
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            if fn in traced:
+                continue
+            traced.add(fn)
+            for node in _own_nodes(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    callee = scopes.resolve(node, node.func.id)
+                    if callee is not None and callee not in traced:
+                        work.append(callee)
+        return traced
+
+    return project.fact(f"traced:{module.path}", build)
+
+
+# -- taint: which local names may hold traced values ----------------------
+
+
+#: Attribute reads that yield static (Python-level) metadata even on a
+#: tracer — values derived through them are NOT traced.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding"}
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    """Loaded names that can carry a *traced value* out of ``node``:
+    skips subtrees under static-metadata attributes (``x.shape[0]`` is a
+    Python int at trace time, not a tracer)."""
+    out: set[str] = set()
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _target_names(target: ast.AST) -> set[str]:
+    return {
+        n.id for n in ast.walk(target)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store,))
+    }
+
+
+def tainted_names(fn: ast.AST) -> set[str]:
+    """Forward may-analysis: parameters are traced values; anything assigned
+    from an expression mentioning a traced name may be traced too."""
+    if isinstance(fn, ast.Lambda):
+        args = fn.args
+    else:
+        args = fn.args
+    taint: set[str] = {
+        a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
+    }
+    if args.vararg:
+        taint.add(args.vararg.arg)
+    if args.kwarg:
+        taint.add(args.kwarg.arg)
+    if isinstance(fn, ast.Lambda):
+        return taint
+    for _ in range(10):  # fixpoint (bounded; assignments chains are short)
+        changed = False
+        for node in _own_nodes(fn):
+            value = None
+            targets: set[str] = set()
+            if isinstance(node, ast.Assign):
+                value = node.value
+                for t in node.targets:
+                    targets |= _target_names(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                value = node.value
+                if node.value is not None:
+                    targets |= _target_names(node.target)
+            elif isinstance(node, ast.NamedExpr):
+                value = node.value
+                targets |= _target_names(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                value = node.iter
+                targets |= _target_names(node.target)
+            if value is not None and targets and (_names_in(value) & taint):
+                if not targets <= taint:
+                    taint |= targets
+                    changed = True
+        if not changed:
+            break
+    return taint
+
+
+def _excluded_use(module: Module, name_node: ast.Name, test: ast.AST) -> bool:
+    """Uses of a traced name inside a branch test that are static at trace
+    time or unknowable: ``x is None`` identity checks, ``isinstance``,
+    static-metadata attributes, and names that only feed *arguments of a
+    call* (``if use_pallas(device):`` — the callee may be a pure config
+    predicate; flagging every such call would drown the signal)."""
+    cur: ast.AST | None = name_node
+    while cur is not None and cur is not test:
+        parent = module.parent.get(cur)
+        if isinstance(parent, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+        ):
+            return True
+        if isinstance(parent, ast.Attribute) and parent.attr in STATIC_ATTRS:
+            return True
+        if isinstance(parent, ast.Call) and cur is not parent.func:
+            return True
+        cur = parent
+    return False
+
+
+# -- rules -----------------------------------------------------------------
+
+
+@rule("host-sync-in-jit")
+def host_sync_in_jit(module: Module, project: Project) -> list[Finding]:
+    """Host-synchronizing calls reachable inside a traced (jit / shard_map /
+    lax-control-flow) body. Each one either fails at trace time or — worse —
+    silently moves the resident hot loop back onto the host round-trip path
+    the engine exists to avoid (docs/HW_VALIDATION.md: ~360 ms per dispatch
+    vs ~0.5 ms per on-device cycle)."""
+    findings: list[Finding] = []
+    for fn in traced_functions(module, project):
+        taint = tainted_names(fn)
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in HOST_SYNC_METHODS
+            ):
+                findings.append(Finding(
+                    "host-sync-in-jit", module.path, node.lineno,
+                    node.col_offset,
+                    f".{node.func.attr}() inside a traced function forces a "
+                    "device->host sync; keep reductions on device and read "
+                    "results outside the jitted step",
+                ))
+                continue
+            qual = module.qualname(node.func)
+            if qual in HOST_SYNC_CALLS:
+                findings.append(Finding(
+                    "host-sync-in-jit", module.path, node.lineno,
+                    node.col_offset,
+                    f"{qual}() inside a traced function materializes device "
+                    "values on host (implicit transfer); use jnp ops or "
+                    "move the conversion outside the traced region",
+                ))
+                continue
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("int", "float", "bool")
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+                and (_names_in(node.args[0]) & taint)
+            ):
+                findings.append(Finding(
+                    "host-sync-in-jit", module.path, node.lineno,
+                    node.col_offset,
+                    f"{node.func.id}() on a traced value concretizes it "
+                    "(ConcretizationTypeError at trace time, or a silent "
+                    "host sync); use .astype()/jnp casts instead",
+                ))
+    return findings
+
+
+@rule("tracer-branch")
+def tracer_branch(module: Module, project: Project) -> list[Finding]:
+    """Python ``if``/``while`` on a possibly-traced value inside a traced
+    function — fails at trace time (ConcretizationTypeError) or, with
+    concrete sizes, silently bakes one branch into the compiled program."""
+    findings: list[Finding] = []
+    for fn in traced_functions(module, project):
+        taint = tainted_names(fn)
+        if isinstance(fn, ast.Lambda):
+            continue
+        for node in _own_nodes(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            uses = [
+                n for n in ast.walk(node.test)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in taint
+            ]
+            live = [n for n in uses if not _excluded_use(module, n, node.test)]
+            if live:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                names = ", ".join(sorted({n.id for n in live}))
+                findings.append(Finding(
+                    "tracer-branch", module.path, node.lineno, node.col_offset,
+                    f"Python `{kind}` on possibly-traced value(s) {names} "
+                    "inside a traced function; use lax.cond/lax.while_loop/"
+                    "jnp.where",
+                ))
+    return findings
+
+
+# -- static-arg-hygiene ----------------------------------------------------
+
+_SCALAR_ANN = {"int", "bool", "str"}
+
+
+def _scalar_like(arg: ast.arg) -> bool:
+    if arg.annotation is not None:
+        names = {
+            n.id for n in ast.walk(arg.annotation) if isinstance(n, ast.Name)
+        }
+        # `int | jax.Array`-style unions that admit an array are fine.
+        if names & {"Array", "ArrayLike", "ndarray"}:
+            return False
+        return bool(names & _SCALAR_ANN)
+    return False
+
+
+def _jit_static_sets(call: ast.Call) -> tuple[set[int], set[str]]:
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    return nums, names
+
+
+@rule("static-arg-hygiene")
+def static_arg_hygiene(module: Module, project: Project) -> list[Finding]:
+    """Jitted entry points whose Python-scalar parameters (per annotation or
+    scalar default) are not declared static. Passing them dynamic traces
+    them to weak-typed 0-d arrays — shape-controlling uses fail, and every
+    call site converting via int() re-syncs; declaring them static makes the
+    recompile-per-value cost explicit and intentional."""
+    scopes = _Scopes(module)
+    findings: list[Finding] = []
+    # (def, static nums, static names) bindings from decorators + jit calls
+    bindings: list[tuple[ast.AST, set[int], set[str], int, int]] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_entry_ref(module, dec) and _final(module, dec) == "jit":
+                    bindings.append((node, set(), set(), dec.lineno, dec.col_offset))
+                elif isinstance(dec, ast.Call):
+                    if _is_trace_entry(module, dec) and _final(module, dec.func) == "jit":
+                        nums, names = _jit_static_sets(dec)
+                        bindings.append((node, nums, names, dec.lineno, dec.col_offset))
+                    elif _partial_trace_entry(module, dec) and _final(module, dec.args[0]) == "jit":
+                        nums, names = _jit_static_sets(dec)
+                        bindings.append((node, nums, names, dec.lineno, dec.col_offset))
+        elif isinstance(node, ast.Call) and _is_trace_entry(module, node):
+            if _final(module, node.func) != "jit" or not node.args:
+                continue
+            fn_ref = node.args[0]
+            if isinstance(fn_ref, ast.Name):
+                target = scopes.resolve(node, fn_ref.id)
+                if target is not None and not isinstance(target, ast.Lambda):
+                    nums, names = _jit_static_sets(node)
+                    bindings.append(
+                        (target, nums, names, node.lineno, node.col_offset)
+                    )
+    for fn, nums, names, line, col in bindings:
+        args = fn.args.posonlyargs + fn.args.args
+        for i, a in enumerate(args):
+            if a.arg == "self" and i == 0:
+                continue
+            if i in nums or a.arg in names:
+                continue
+            if _scalar_like(a):
+                findings.append(Finding(
+                    "static-arg-hygiene", module.path, line, col,
+                    f"jitted '{getattr(fn, 'name', '<lambda>')}' takes "
+                    f"Python-scalar param '{a.arg}' dynamically; add it to "
+                    "static_argnames (explicit recompile-per-value) or pass "
+                    "a jnp array",
+                ))
+        for a in fn.args.kwonlyargs:
+            if a.arg not in names and _scalar_like(a):
+                findings.append(Finding(
+                    "static-arg-hygiene", module.path, line, col,
+                    f"jitted '{getattr(fn, 'name', '<lambda>')}' takes "
+                    f"Python-scalar keyword param '{a.arg}' dynamically; "
+                    "add it to static_argnames or pass a jnp array",
+                ))
+    return findings
+
+
+def _final(module: Module, node: ast.AST) -> str | None:
+    qual = module.qualname(node)
+    return qual.split(".")[-1] if qual else None

@@ -119,6 +119,12 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_argument("--profile", type=str, default=None,
                         help="write a jax profiler trace of the search to "
                         "this directory (view with TensorBoard/XProf)")
+    common.add_argument("--guard", action="store_true",
+                        help="resident tiers: assert every steady-state "
+                        "device dispatch performs zero recompilations and "
+                        "zero implicit host transfers (fail loudly instead "
+                        "of silently paying ~360ms/cycle round trips; "
+                        "equivalent to TTS_GUARD=1 — docs/ANALYSIS.md)")
 
     nq = sub.add_parser("nqueens", parents=[common], help="N-Queens backtracking")
     nq.add_argument("--N", type=int, default=14, help="number of queens")
@@ -129,11 +135,30 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_argument("--lb", type=str, default="lb1", choices=["lb1", "lb1_d", "lb2"])
     pf.add_argument("--ub", type=int, default=1, choices=[0, 1],
                     help="initial upper bound: 1=known optimum, 0=inf")
+
+    lint = sub.add_parser(
+        "lint",
+        help="JAX-aware static analysis: host-sync-in-jit, tracer-branch, "
+        "guarded-by, static-arg-hygiene (docs/ANALYSIS.md)",
+    )
+    from .analysis import add_lint_args
+
+    add_lint_args(lint)
     return p
 
 
 def validate_args(parser: argparse.ArgumentParser, args) -> None:
     """Reject flag combinations that would otherwise be silently ignored."""
+    if args.guard and not (
+        args.tier in ("mesh", "dist_mesh")
+        or (args.tier == "device" and args.engine == "resident")
+    ):
+        parser.error(
+            "--guard asserts steady-state purity of the resident device "
+            "loops (--tier device with the resident engine, mesh, "
+            "dist_mesh); the offload/multi/dist workers round-trip every "
+            "chunk by design"
+        )
     if args.tier in ("mesh", "dist_mesh") and args.engine == "offload":
         parser.error(
             "--engine offload is not available for this tier "
@@ -244,22 +269,29 @@ def uses_compaction(args) -> bool:
 def run_tier(problem, args):
     args.M = resolve_chunk_size(args.M, getattr(problem, "name", ""),
                                 args.tier, args.engine)
+    # Flag > env for THIS run only: restore on exit so a caller invoking
+    # main() twice in one process does not inherit the pins (compaction
+    # programs cache per mode via the routing token; the guard is read at
+    # engine start).
+    pins = {}
     if args.compact is not None:
-        import os
+        pins["TTS_COMPACT"] = args.compact
+    if args.guard:
+        pins["TTS_GUARD"] = "1"
+    if not pins:
+        return _dispatch_tier(problem, args)
+    import os
 
-        # Flag > env for THIS run only: restore on exit so a caller
-        # invoking main() twice in one process does not inherit the pin
-        # (programs cache per mode via the routing token).
-        prev = os.environ.get("TTS_COMPACT")
-        os.environ["TTS_COMPACT"] = args.compact
-        try:
-            return _dispatch_tier(problem, args)
-        finally:
-            if prev is None:
-                os.environ.pop("TTS_COMPACT", None)
+    prev = {k: os.environ.get(k) for k in pins}
+    os.environ.update(pins)
+    try:
+        return _dispatch_tier(problem, args)
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
             else:
-                os.environ["TTS_COMPACT"] = prev
-    return _dispatch_tier(problem, args)
+                os.environ[k] = v
 
 
 def _dispatch_tier(problem, args):
@@ -503,6 +535,11 @@ def enable_compile_cache() -> None:
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.problem == "lint":
+        # Pure static analysis: no jax import, no backend init.
+        from .analysis import run_lint_cli
+
+        return run_lint_cli(args)
     validate_args(parser, args)
     primary = True
     if args.distributed:

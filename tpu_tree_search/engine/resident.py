@@ -38,7 +38,6 @@ depends on the capacity heuristic.
 from __future__ import annotations
 
 import time
-from functools import partial
 
 import numpy as np
 
@@ -195,6 +194,7 @@ class _ResidentProgram:
         evaluate = self._make_eval()
         swap_of = self._swap_pos
 
+        # tts-lint: traced (returned to lax.while_loop via loop_fns)
         def body(carry):
             pool_vals, pool_aux, size, best, tree, sol, cycles = carry
             cnt = jnp.minimum(size, M)
@@ -258,6 +258,7 @@ class _ResidentProgram:
                 tree + tree_inc, sol + sol_inc, cycles + 1,
             )
 
+        # tts-lint: traced (returned to lax.while_loop via loop_fns)
         def cond(carry):
             _, _, size, _, _, _, cycles = carry
             return (size >= m) & (size + Mn <= C) & (cycles < K)
@@ -387,6 +388,7 @@ class _PFSPResident(_ResidentProgram):
             and P.lb2_staged_enabled(device, n)
         )
 
+        # tts-lint: traced (called from the while-loop body's evaluate hook)
         def evaluate(prmu_c, limit1_c, valid, best):
             pdepth = limit1_c + 1
             kk = jnp.arange(n, dtype=jnp.int32)[None, :]
@@ -458,6 +460,7 @@ class _NQueensResident(_ResidentProgram):
         N = self.problem.N
         core = nqueens_device.make_labels(N, self.problem.g, self.device)
 
+        # tts-lint: traced (called from the while-loop body's evaluate hook)
         def evaluate(board_c, depth_c, valid, best):
             # A popped node at depth == N is a solution (`nqueens_chpl.chpl:74`).
             sol_inc = jnp.sum(valid & (depth_c == N), dtype=jnp.int32)
@@ -547,6 +550,7 @@ def resident_search(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 60.0,
     resume_from: str | None = None,
+    guard: bool | None = None,
 ) -> SearchResult:
     """3-phase search with a device-resident hot loop.
 
@@ -559,6 +563,12 @@ def resident_search(
     ``checkpoint_interval_s`` and at a ``max_steps`` cutoff (which returns
     ``complete=False``); ``resume_from`` seeds the search from a saved file
     and keeps counting.
+
+    Guard mode (``guard=True`` or TTS_GUARD=1, docs/ANALYSIS.md): every
+    steady-state dispatch is asserted to reuse the compiled step (zero
+    recompilations) and to run under ``jax.transfer_guard("disallow")`` —
+    a regression that re-introduces a per-cycle host round trip raises
+    ``GuardViolation`` instead of silently costing ~360 ms per cycle.
     """
     best = (
         initial_best
@@ -612,8 +622,15 @@ def resident_search(
         problem, checkpoint_path, checkpoint_interval_s, max_steps, snapshot_fn
     )
 
+    from ..analysis.guard import SteadyStateGuard, guard_enabled
+
+    sguard = SteadyStateGuard(
+        program._step, "resident step", enabled=guard_enabled(guard)
+    )
+
     while True:
-        out = program.step(state)
+        with sguard.step():
+            out = program.step(state)
         state, tree_inc, sol_inc, cycles = program.read(out)
         tree2 += tree_inc
         sol2 += sol_inc
@@ -665,6 +682,9 @@ def resident_search(
             state = program.init_state(pool.as_batch(), best)
             pool.clear()
             diagnostics.host_to_device += 1
+            # The re-upload is a sanctioned host round trip; the next
+            # dispatch is a fresh warm one for the guard.
+            sguard.rearm()
     batch, size, best = program.residual(state)
     diagnostics.device_to_host += 1
     pool.reset_from(batch)

@@ -149,6 +149,12 @@ def _host_loop(
     pool.clear()
     diagnostics.host_to_device += 1
 
+    from ..analysis.guard import SteadyStateGuard, guard_enabled
+
+    sguard = SteadyStateGuard(
+        program._step, "dist-mesh step", enabled=guard_enabled(None)
+    )
+
     tree2 = 0
     sol2 = 0
     steps = 0
@@ -171,6 +177,9 @@ def _host_loop(
         nonlocal state
         state = program.init_state(_stride_shards(p.as_batch(), D), best)
         diagnostics.host_to_device += 1
+        # Donation-round re-uploads are sanctioned host round trips: the
+        # next dispatch is a fresh warm one for the steady-state guard.
+        sguard.rearm()
 
     import pickle
     import uuid as _uuid
@@ -200,7 +209,8 @@ def _host_loop(
         )
 
     while True:
-        out = program.step(state)
+        with sguard.step():
+            out = program.step(state)
         state, ti, si, cy, sizes, best, tree_vec = program.read_stats(out)
         tree2 += ti
         sol2 += si

@@ -34,7 +34,7 @@ import numpy as np
 from ..engine.device import DeviceOffloader, bucket_size, drain, warmup
 from ..engine.results import Diagnostics, PhaseStats, SearchResult
 from ..pool import ParallelSoAPool, SoAPool
-from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
+from ..problems.base import INF_BOUND, Problem, index_batch
 from ..utils import TaskStates
 
 
@@ -43,7 +43,7 @@ class _SharedBest:
     reference's terminal-only reconciliation, BASELINE.json north star)."""
 
     def __init__(self, value: int):
-        self._value = value
+        self._value = value  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def publish(self, value: int) -> int:
@@ -53,7 +53,8 @@ class _SharedBest:
             return self._value
 
     def read(self) -> int:
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class PauseGate:
@@ -70,9 +71,9 @@ class PauseGate:
 
     def __init__(self, n_workers: int):
         self._cond = threading.Condition()
-        self.active = n_workers
-        self.paused = 0
-        self.want = False
+        self.active = n_workers  # guarded-by: _cond
+        self.paused = 0  # guarded-by: _cond
+        self.want = False  # guarded-by: _cond
 
     def poll(self) -> None:
         with self._cond:
@@ -112,7 +113,8 @@ class CheckpointManager:
     history)."""
 
     def __init__(self, problem: Problem, path: str, gate: PauseGate,
-                 pools, workers, shared, base_tree: int, base_sol: int,
+                 pools: list[ParallelSoAPool], workers, shared,
+                 base_tree: int, base_sol: int,
                  interval_s: float = 60.0, hosts: int = 1):
         self.problem = problem
         self.path = path
@@ -144,6 +146,7 @@ class CheckpointManager:
                 return False
             merged = {k: [] for k in self.problem.empty_batch(0)}
             for p in self.pools:
+                # tts-lint: waive guarded-by -- workers are quiesced at the PauseGate rendezvous; no thread can mutate pools until resume()
                 b = p.as_batch()
                 for k in merged:
                     merged[k].append(b[k])
@@ -202,6 +205,7 @@ def _partition(problem: Problem, pool: SoAPool, D: int) -> list[ParallelSoAPool]
     pools = []
     for w in range(D):
         p = ParallelSoAPool(problem.node_fields())
+        # tts-lint: waive guarded-by -- pool is thread-local until run_workers hands it to a worker thread
         p.push_back_bulk({k: v[w::D] for k, v in batch.items()})
         pools.append(p)
     return pools
@@ -384,6 +388,7 @@ def run_workers(
         raise comm.error
     # leftovers back into the global pool (`pfsp_multigpu_chpl.chpl:498-503`)
     for p in pools:
+        # tts-lint: waive guarded-by -- worker and communicator threads are joined; no concurrent access remains
         leftover.push_back_bulk(p.as_batch())
     tree2 = sum(w.tree for w in workers)
     sol2 = sum(w.sol for w in workers)

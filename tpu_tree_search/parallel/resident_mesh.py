@@ -396,11 +396,14 @@ def mesh_resident_search(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 60.0,
     resume_from: str | None = None,
+    guard: bool | None = None,
 ) -> SearchResult:
     """SPMD multi-device search: 3 phases like every tier, with phase 2 one
     sharded resident program (see module docstring). Checkpoint/resume as in
     ``resident_search`` (a mesh snapshot merges every shard's frontier, and a
-    resumed frontier re-partitions stride-D, so D may change across runs)."""
+    resumed frontier re-partitions stride-D, so D may change across runs).
+    ``guard``/TTS_GUARD=1 asserts zero recompiles + zero implicit transfers
+    per steady-state dispatch, exactly as in ``resident_search``."""
     import jax
     from jax.sharding import Mesh
 
@@ -500,8 +503,15 @@ def mesh_resident_search(
         problem, checkpoint_path, checkpoint_interval_s, max_steps, snapshot_fn
     )
 
+    from ..analysis.guard import SteadyStateGuard, guard_enabled
+
+    sguard = SteadyStateGuard(
+        program._step, "mesh-resident step", enabled=guard_enabled(guard)
+    )
+
     while True:
-        out = program.step(state)
+        with sguard.step():
+            out = program.step(state)
         state, ti, si, cy, sizes, best, tree_vec = program.read_stats(out)
         tree2 += ti
         sol2 += si
@@ -555,6 +565,8 @@ def mesh_resident_search(
             state = upload(pool.as_batch())
             pool.clear()
             diagnostics.host_to_device += 1
+            # Sanctioned re-upload; next dispatch is a fresh warm one.
+            sguard.rearm()
             prev_sizes = None
             continue
         prev_sizes = sizes

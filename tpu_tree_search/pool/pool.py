@@ -181,7 +181,20 @@ class ParallelSoAPool(SoAPool):
     task yields (`Pool_par.chpl:28-40`); host threads here use a mutex with
     ``try_lock`` exposed for the bounded-retry steal loop
     (`nqueens_multigpu_chpl.chpl:268-293`).
+
+    Concurrency contract (checked by `tts lint`, rule ``guarded-by`` —
+    docs/ANALYSIS.md): once an instance is shared with worker threads, its
+    SoA state may only be touched with ``lock`` held — via the ``locked_*``
+    wrappers, ``with pool.lock:``, or the taken branch of
+    ``if pool.try_lock():``. The inherited unsynchronized methods carry the
+    caller-must-hold-the-lock contract below.
     """
+
+    # guarded-by: lock -- front, size, capacity, data
+    # requires-lock: lock -- push_back, pop_back, pop_front, push_back_bulk
+    # requires-lock: lock -- pop_back_bulk, pop_back_bulk_all
+    # requires-lock: lock -- pop_front_bulk_half, as_batch, reset_from, clear
+    # requires-lock: lock -- _ensure
 
     def __init__(self, fields, capacity: int = INITIAL_CAPACITY):
         super().__init__(fields, capacity)
