@@ -558,32 +558,80 @@ def eval_microbench(problem, on_tpu: bool, iters: int | None = None) -> dict:
 COMPACT_MODES = ("scatter", "sort", "search")
 
 
+@contextmanager
+def _mode_timeout(seconds: float | None):
+    """Best-effort hard wall-clock bound for one in-process measurement:
+    ``SIGALRM`` + ``setitimer`` raise ``TimeoutError`` inside the running
+    mode instead of merely gating the next one. Limitations (why the
+    subprocess probes still exist): signals deliver only in the MAIN
+    thread — elsewhere this is a no-op — and a native call that never
+    returns to the interpreter (a truly hung Mosaic compile) postpones
+    delivery until it does; long-but-finite compiles and runs ARE
+    interrupted, which is the case the budget exists for."""
+    import signal
+    import threading
+
+    if (
+        seconds is None
+        or threading.current_thread() is not threading.main_thread()
+        or not hasattr(signal, "setitimer")
+    ):
+        yield
+        return
+
+    def _raise(signum, frame):
+        raise TimeoutError(f"mode run exceeded its {seconds:.0f}s slice")
+
+    old = signal.signal(signal.SIGALRM, _raise)
+    signal.setitimer(signal.ITIMER_REAL, max(seconds, 1e-3))
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old)
+
+
 def pick_compact(run_fn, parity_fn, budget_s: float | None = None):
     """Measure ``run_fn()`` under each compaction mode (TTS_COMPACT) and
     pick the fastest PARITY-PASSING one (fallback: fastest overall — a
     fast-but-wrong mode must never displace a clean measurement, but if
     none is clean the caller's own parity gate reports it). Per-mode
-    failures are recorded, never fatal. ``budget_s`` bounds the whole A/B:
-    the first mode always runs (old single-mode behavior is the floor),
-    later modes are skipped once the budget is spent — a driver bench
-    hitting cold Mosaic/XLA compiles for the new modes must degrade to
-    fewer measurements, never blow its timeout. Returns
-    ``(stats, best_run)``; ``(None, None)`` if every mode failed to run.
-    Shared by the headline A/B and the N-Queens probe so the mode list
-    and selection rule cannot drift apart."""
+    failures are recorded, never fatal.
+
+    ``budget_s`` is a HARD bound on the whole A/B, not just a start gate:
+    each mode runs inside its remaining slice of the budget under
+    ``_mode_timeout`` (SIGALRM), so a mode that begins just under the
+    budget is interrupted rather than overrunning arbitrarily (ADVICE r5).
+    The first mode gets the full budget (the old single-mode behavior is
+    the floor — if IT times out, the caller's fallback plain run still
+    produces the record); later modes get what is left and are skipped
+    outright once nothing is. Residual overshoot is limited to native
+    calls that never re-enter the interpreter (see ``_mode_timeout``).
+    Returns ``(stats, best_run)``; ``(None, None)`` if every mode failed
+    to run. Shared by the headline A/B and the N-Queens probe so the mode
+    list and selection rule cannot drift apart."""
     runs, nps, par, errors = {}, {}, {}, {}
     t0 = time.monotonic()
     skipped = []
     for i, mode in enumerate(COMPACT_MODES):
-        # Only the FIRST mode is exempt: a mode that burns the budget and
-        # then fails must still stop the A/B (the guarantee is a bound on
-        # total wall time, success or not).
-        if i > 0 and budget_s is not None and time.monotonic() - t0 > budget_s:
+        remaining = (
+            None if budget_s is None
+            else budget_s - (time.monotonic() - t0)
+        )
+        # Only the FIRST mode is exempt from the skip (it still runs under
+        # the full-budget timeout): a mode that burns the budget and then
+        # fails must still stop the A/B (the guarantee is a bound on total
+        # wall time, success or not).
+        if i > 0 and remaining is not None and remaining <= 0:
             skipped.append(mode)
             continue
         try:
-            with _env_override("TTS_COMPACT", mode):
+            with _env_override("TTS_COMPACT", mode), \
+                    _mode_timeout(budget_s if i == 0 else remaining):
                 r = run_fn()
+        except TimeoutError as e:
+            errors[mode] = f"TimeoutError: {e}"
+            continue
         except Exception as e:  # noqa: BLE001 — one mode must not kill the rest
             errors[mode] = f"{type(e).__name__}: {e}"
             continue
@@ -726,6 +774,15 @@ def main() -> int:
                      eval_microbench(prob_hl, on_tpu)}
     except Exception as e:  # noqa: BLE001 — selection is best-effort
         micro = {"error": f"{type(e).__name__}: {e}"}
+    # Host-event trace of the headline run (TTS_OBS=host: host tracing
+    # only, device programs stay byte-identical — the measurement is NOT
+    # perturbed; docs/OBSERVABILITY.md). An explicit TTS_OBS is respected.
+    from tpu_tree_search.obs import events as obs_events
+
+    _obs_prev = os.environ.get("TTS_OBS")
+    if _obs_prev is None:
+        os.environ["TTS_OBS"] = "host"
+    obs_events.reset()
     try:
         # -- headline: PFSP ta014 lb1 --------------------------------------
         # A jnp demotion is scoped to THIS run: the lb2/nqueens extras have
@@ -794,6 +851,35 @@ def main() -> int:
             "parity": False,
             "error": f"{type(e).__name__}: {e}",
         }
+    # Attach the headline trace artifact (never fatal): Perfetto-loadable
+    # file next to the bench, summary riding the JSON line.
+    hl_events = obs_events.drain()
+    if _obs_prev is None:
+        os.environ.pop("TTS_OBS", None)
+    try:
+        import tempfile
+
+        from tpu_tree_search.obs import export as obs_export
+        from tpu_tree_search.obs import report as obs_report
+
+        # Committed artifact only for real on-chip runs (the
+        # BENCH_LAST_GOOD.json policy); CPU smoke runs — including the
+        # express e2e test — must not dirty the working tree.
+        trace_dir = (
+            os.path.dirname(LAST_GOOD_PATH) if on_tpu
+            else tempfile.gettempdir()
+        )
+        trace_path = os.path.join(trace_dir, "BENCH_TRACE.json")
+        n_ev = obs_export.write_chrome_trace(
+            hl_events, trace_path, label="bench-headline"
+        )
+        record["trace"] = {
+            "path": os.path.basename(trace_path) if on_tpu else trace_path,
+            "events": n_ev,
+            "span_s": obs_report.summarize(hl_events)["span_s"],
+        }
+    except Exception:  # noqa: BLE001 — bookkeeping must not cost the line
+        pass
 
     # -- extras: ta014 lb2 + N-Queens N=15 (never fail the bench; express
     # mode skips them all and shares the finalization tail below) ----------

@@ -8,12 +8,12 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== 1/7 backend liveness =="
+echo "== 1/8 backend liveness =="
 if ! timeout 120 python -c "import jax; print(jax.devices())"; then
   echo "TPU unreachable — aborting hardware session"; exit 1
 fi
 
-echo "== 2/7 express bench (first on-chip number in the smallest window) =="
+echo "== 2/8 express bench (first on-chip number in the smallest window) =="
 set -o pipefail
 if TTS_BENCH_EXPRESS=1 timeout 600 python bench.py \
     | tee /tmp/tts_bench_express.json; then
@@ -22,7 +22,7 @@ else
   echo "EXPRESS BENCH FAILED"
 fi
 
-echo "== 3/7 bench (full; overwrites BENCH_LAST_GOOD.json on success) =="
+echo "== 3/8 bench (full; overwrites BENCH_LAST_GOOD.json on success) =="
 if timeout 3000 python bench.py | tee /tmp/tts_bench_line.json; then
   echo "BENCH OK"
 else
@@ -33,13 +33,25 @@ else
 fi
 set +o pipefail
 
-echo "== 4/7 Pallas smoke gate (hardware compiles + oracle parity) =="
+echo "== 4/8 Pallas smoke gate (hardware compiles + oracle parity) =="
 TTS_TPU_TESTS=1 timeout 3000 python -m pytest tests/test_tpu_smoke.py -v
 
-echo "== 5/7 warm AOT compile cache for the validation matrix =="
+echo "== 5/8 warm AOT compile cache for the validation matrix =="
 timeout 1200 python scripts/warm_cache.py || true
 
-echo "== 6/7 chunk-size sweeps (un-measured configs first) =="
+echo "== 6/8 guard-safe telemetry smoke (traced headline run + tts report) =="
+# The obs acceptance run (docs/OBSERVABILITY.md): full counters + trace
+# under the steady-state guard — zero guard violations required — then the
+# report summarizer over the written trace.
+if timeout 900 python -m tpu_tree_search.cli pfsp --inst 14 --tier device \
+    --trace /tmp/tts_headline_trace.json --guard; then
+  timeout 120 python -m tpu_tree_search.cli report /tmp/tts_headline_trace.json \
+    || echo "TTS REPORT FAILED"
+else
+  echo "TRACED GUARDED RUN FAILED"
+fi
+
+echo "== 7/8 chunk-size sweeps (un-measured configs first) =="
 # N-Queens was never chunk-tuned (bench extra sits at 0.28x ref C while
 # PFSP gained 1.3-3x from tuning); quick PFSP passes re-validate the
 # banked defaults against drift.
@@ -60,7 +72,7 @@ TTS_COMPACT=search timeout 1200 python scripts/headline_tune.py --quick || true
 timeout 900 python scripts/cycle_profile.py --M 1024 || true
 timeout 900 python scripts/cycle_profile.py --M 65536 --cycles 16 || true
 
-echo "== 7/7 tile sweep (per-kernel compile/throughput; informational) =="
+echo "== 8/8 tile sweep (per-kernel compile/throughput; informational) =="
 # Full ta014 tables were measured in the round-5 session
 # (docs/HW_VALIDATION.md); re-run is cheap with a warm cache and catches
 # compile-time regressions.

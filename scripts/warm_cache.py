@@ -80,6 +80,20 @@ CONFIGS: list[tuple[str, list[str], dict[str, str]]] = [
     ("ta014 lb1 M=1024", ["pfsp", "14", "lb1", "-", "1024"], {}),
     ("ta014 lb1_d M=1024", ["pfsp", "14", "lb1_d", "-", "1024"], {}),
     ("nqueens N=15 M=65536", ["nqueens", "15", "65536"], {}),
+    # Compaction-mode variants (ADVICE r5): bench's on-TPU A/B also
+    # dispatches TTS_COMPACT=sort and =search builds of the headline and
+    # lb2 programs (compact_mode is part of the routing token, so each is
+    # a distinct compile) — warm them too, or a fresh cache makes the pick
+    # burn its 600s/300s budget on compiles and skip modes. A green window
+    # banks all three compaction programs for both configs.
+    ("ta014 lb1 M=1024 compact=sort", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_COMPACT": "sort"}),
+    ("ta014 lb1 M=1024 compact=search", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_COMPACT": "search"}),
+    ("ta014 lb2 M=1024 compact=sort", ["pfsp", "14", "lb2", "-", "1024"],
+     {"TTS_COMPACT": "sort"}),
+    ("ta014 lb2 M=1024 compact=search", ["pfsp", "14", "lb2", "-", "1024"],
+     {"TTS_COMPACT": "search"}),
     # Large-instance classes (VERDICT r4 #7): ta031 = 50x10, ta056 = 50x20,
     # ta111 = 500x20. Kernel-level at the smoke-gate shapes (see _ITEM's
     # "kernel" note); the set mirrors test_large_instance_kernels_compile_on_tpu.

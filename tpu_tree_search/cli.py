@@ -119,6 +119,16 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_argument("--profile", type=str, default=None,
                         help="write a jax profiler trace of the search to "
                         "this directory (view with TensorBoard/XProf)")
+    common.add_argument("--trace", type=str, default=None,
+                        help="write a Chrome-trace-event JSON of the run's "
+                        "telemetry to this file (open in Perfetto; "
+                        "summarize with `tts report`); implies TTS_OBS=1 "
+                        "unless TTS_OBS is already set "
+                        "(docs/OBSERVABILITY.md)")
+    common.add_argument("--metrics-file", type=str, default=None,
+                        help="append one JSON line per telemetry counter "
+                        "sample to this file (scrape-ready); implies "
+                        "TTS_OBS=1 unless TTS_OBS is already set")
     common.add_argument("--guard", action="store_true",
                         help="resident tiers: assert every steady-state "
                         "device dispatch performs zero recompilations and "
@@ -144,6 +154,15 @@ def build_parser() -> argparse.ArgumentParser:
     from .analysis import add_lint_args
 
     add_lint_args(lint)
+
+    rep = sub.add_parser(
+        "report",
+        help="summarize a --trace file: steal efficiency, idle fraction "
+        "per worker, cycle-rate timeline (docs/OBSERVABILITY.md)",
+    )
+    rep.add_argument("trace", help="trace file written by --trace")
+    rep.add_argument("--json", action="store_true", dest="report_json",
+                     help="emit the summary as one JSON object")
     return p
 
 
@@ -239,7 +258,9 @@ def resolve_chunk_size(M, problem_name: str, tier: str, engine: str,
     pays a ~360ms host round trip; small chunks would multiply them),
     non-TPU backends (unmeasured), N-Queens (wide frontiers fill big
     chunks), and the sharded tiers (M is per shard) — keeps the
-    reference's 50000 (`util.chpl` default). The candidate combination is
+    reference's 50000 (the per-program ``config const M = 50000`` of each
+    GPU main, `pfsp_gpu_chpl.chpl:24` / `nqueens_gpu_chpl.chpl:21`; it is
+    not defined in `util.chpl`). The candidate combination is
     checked BEFORE the backend so non-candidates (e.g. ``--tier seq``)
     never touch jax."""
     if M is not None:
@@ -273,14 +294,22 @@ def run_tier(problem, args):
     # main() twice in one process does not inherit the pins (compaction
     # programs cache per mode via the routing token; the guard is read at
     # engine start).
+    import os
+
     pins = {}
     if args.compact is not None:
         pins["TTS_COMPACT"] = args.compact
     if args.guard:
         pins["TTS_GUARD"] = "1"
+    if (
+        (args.trace is not None or args.metrics_file is not None)
+        and "TTS_OBS" not in os.environ
+    ):
+        # --trace/--metrics-file turn telemetry on for the run; an explicit
+        # TTS_OBS (e.g. =host to keep device programs untouched) wins.
+        pins["TTS_OBS"] = "1"
     if not pins:
         return _dispatch_tier(problem, args)
-    import os
 
     prev = {k: os.environ.get(k) for k in pins}
     os.environ.update(pins)
@@ -462,6 +491,11 @@ def result_record(args, res) -> dict:
         rec["steals"] = res.steals
     if res.comm:
         rec["comm"] = res.comm
+    if res.obs:
+        # On-device counter totals (TTS_OBS=1): the stats line carries the
+        # run's telemetry snapshot like the reference's diagnostics counters
+        # ride its .dat lines.
+        rec["obs"] = res.obs
     if args.problem == "pfsp":
         rec.update(inst=args.inst, lb=args.lb, ub=args.ub, optimum=res.best)
     else:
@@ -540,6 +574,11 @@ def main(argv=None) -> int:
         from .analysis import run_lint_cli
 
         return run_lint_cli(args)
+    if args.problem == "report":
+        # Pure trace summarization: no jax import, no backend init.
+        from .obs.report import report_main
+
+        return report_main(args.trace, as_json=args.report_json)
     validate_args(parser, args)
     primary = True
     if args.distributed:
@@ -578,6 +617,12 @@ def main(argv=None) -> int:
         return 2
     if primary:
         print_settings(args)
+    from .obs import events as obs_events
+
+    if args.trace or args.metrics_file or obs_events.enabled():
+        # Run-scoped telemetry: a prior run's events in this process must
+        # not leak into this run's trace.
+        obs_events.reset()
     try:
         if args.profile:
             # Trace the whole search (phase timers remain the first-class
@@ -597,6 +642,16 @@ def main(argv=None) -> int:
     if primary:
         print_results(args, problem, res)
         rec = result_record(args, res)
+        if args.trace or args.metrics_file:
+            from .obs import export as obs_export
+
+            evts = obs_events.drain()
+            if args.trace:
+                n = obs_export.write_chrome_trace(evts, args.trace)
+                print(f"Trace written: {args.trace} ({n} events; "
+                      "open in Perfetto or `tts report`)")
+            if args.metrics_file:
+                obs_export.write_metrics_jsonl(evts, args.metrics_file)
         if args.json:
             print(json.dumps(rec))
         if args.stats_file:

@@ -41,6 +41,8 @@ import time
 
 import numpy as np
 
+from ..obs import counters as obs_counters
+from ..obs import events as ev
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem
 from ..problems.nqueens import NQueensProblem
@@ -172,12 +174,21 @@ class _ResidentProgram:
         # int32 counters.
         self.K = max(1, min(K, (2**31 - 1) // max(1, M * n)))
         self.device = device if device is not None else jax.devices()[0]
+        # On-device cycle counters (TTS_OBS=1, obs/counters.py): baked in at
+        # build time — when off, the carry/body/jaxpr are byte-identical to
+        # a counter-free build (compiled out, not branched). _make_program
+        # keys its cache on this flag.
+        self.obs = obs_counters.device_counters_enabled()
         self._step = self._build()
 
     def loop_fns(self, K: int | None = None):
         """(cond, body) of the K-cycle device loop over the carry
         ``(pool_vals, pool_aux, size, best, tree, sol, cycles)`` — reused by
-        the single-device step and, per shard, by the mesh-resident tier."""
+        the single-device step and, per shard, by the mesh-resident tier.
+        With ``self.obs`` the carry gains one trailing ``(NSLOTS,)`` int32
+        counter block (obs/counters.py), accumulated per cycle and harvested
+        at the dispatch boundary; when off the carry is exactly the 7-tuple
+        above."""
         import jax.numpy as jnp
         from jax import lax
 
@@ -185,6 +196,7 @@ class _ResidentProgram:
         m, M, C = self.m, self.M, self.capacity
         K = self.K if K is None else K
         Mn = M * n
+        obs = self.obs
         # The while condition reserves exactly Mn rows of headroom, so the
         # budget must never exceed Mn (a small M would otherwise make the
         # small-path write overrun the reservation and corrupt live rows).
@@ -196,7 +208,10 @@ class _ResidentProgram:
 
         # tts-lint: traced (returned to lax.while_loop via loop_fns)
         def body(carry):
-            pool_vals, pool_aux, size, best, tree, sol, cycles = carry
+            if obs:
+                pool_vals, pool_aux, size, best, tree, sol, cycles, ctr = carry
+            else:
+                pool_vals, pool_aux, size, best, tree, sol, cycles = carry
             cnt = jnp.minimum(size, M)
             start = size - cnt
             start2 = jnp.clip(start, 0, C - M)
@@ -253,14 +268,20 @@ class _ResidentProgram:
 
             pool_vals, pool_aux = lax.cond(fits, small, big, pool_vals, pool_aux)
             size = size + tree_inc
-            return (
+            out = (
                 pool_vals, pool_aux, size, best,
                 tree + tree_inc, sol + sol_inc, cycles + 1,
             )
+            if obs:
+                ctr = obs_counters.update(
+                    ctr, cnt, n, tree_inc, sol_inc, fits, size
+                )
+                return out + (ctr,)
+            return out
 
         # tts-lint: traced (returned to lax.while_loop via loop_fns)
         def cond(carry):
-            _, _, size, _, _, _, cycles = carry
+            size, cycles = carry[2], carry[6]
             return (size >= m) & (size + Mn <= C) & (cycles < K)
 
         return cond, body
@@ -271,12 +292,14 @@ class _ResidentProgram:
         from jax import lax
 
         cond, body = self.loop_fns()
+        obs = self.obs
 
         def step(pool_vals, pool_aux, size, best):
             zero = jnp.int32(0)
-            return lax.while_loop(
-                cond, body, (pool_vals, pool_aux, size, best, zero, zero, zero)
-            )
+            init = (pool_vals, pool_aux, size, best, zero, zero, zero)
+            if obs:
+                init = init + (obs_counters.init_block(),)
+            return lax.while_loop(cond, body, init)
 
         return jax.jit(step, donate_argnums=(0, 1))
 
@@ -307,9 +330,17 @@ class _ResidentProgram:
         return self._step(*state)
 
     def read(self, out):
-        """Blocks on the step result; returns (state, tree, sol, cycles)."""
+        """Blocks on the step result; returns ``(state, tree, sol, cycles,
+        ctr)`` where ``ctr`` is the harvested counter block (np array) when
+        device counters are on, else None. The reads happen at the dispatch
+        boundary, outside the steady-state guard — the same sanctioned
+        scalar readback the engine always performed."""
+        if self.obs:
+            *state, tree, sol, cycles, ctr = out
+            return (tuple(state), int(tree), int(sol), int(cycles),
+                    np.asarray(ctr))
         *state, tree, sol, cycles = out
-        return tuple(state), int(tree), int(sol), int(cycles)
+        return tuple(state), int(tree), int(sol), int(cycles), None
 
     def residual(self, state) -> tuple[dict, int, int]:
         """Downloads the remaining pool -> (host NodeBatch, size, best)."""
@@ -489,7 +520,10 @@ def _make_program(
     from ..ops.pfsp_device import routing_cache_token
 
     key = (m, M, K, capacity, id(device), mp_axis, mp_size, allow_staged,
-           routing_cache_token(problem, device))
+           routing_cache_token(problem, device),
+           # Counter-block programs are distinct compilations: flipping
+           # TTS_OBS between searches must rebuild, not reuse.
+           obs_counters.device_counters_enabled())
     if key in cache:
         return cache[key]
     if isinstance(problem, PFSPProblem):
@@ -535,6 +569,25 @@ def resolve_capacity(problem: Problem, M: int, capacity: int | None) -> tuple[in
     if 2 * M * n > capacity:
         capacity = 2 * M * n
     return capacity, M
+
+
+def _emit_device_explored(ctr_total: dict | None, tree2: int, sol2: int,
+                          fb_tree: int, fb_sol: int, host: int = 0) -> None:
+    """Phase-2 ``explored`` counter samples. When device counters ran, the
+    device part comes from the harvested block (so the obs totals exercise
+    the counter path, not the engine's own sums — tests pin exact parity)
+    and the overflow-fallback host part is emitted separately; otherwise
+    one sample carries the engine counts."""
+    if not ev.enabled():
+        return
+    if ctr_total is not None:
+        ev.counter("explored", host=host, tree=ctr_total["pushed"],
+                   sol=ctr_total["leaves"], phase=2)
+        if fb_tree or fb_sol:
+            ev.counter("explored", host=host, tree=fb_tree, sol=fb_sol,
+                       phase=2)
+    else:
+        ev.counter("explored", host=host, tree=tree2, sol=sol2, phase=2)
 
 
 def resident_search(
@@ -603,6 +656,7 @@ def resident_search(
         tree1, sol1, best = warmup(problem, pool, best, target)
     t1 = time.perf_counter()
     phases.append(PhaseStats(t1 - t0, tree1, sol1))
+    ev.counter("explored", tree=tree1, sol=sol1, phase=1)
 
     # -- phase 2: device-resident loop ------------------------------------
     program = _make_program(problem, m, M, K, capacity, device)
@@ -628,20 +682,44 @@ def resident_search(
         program._step, "resident step", enabled=guard_enabled(guard)
     )
 
+    ctr_total: dict | None = None
+    fb_tree = fb_sol = 0  # overflow-fallback host increments (obs parity)
+    prev_best = best
+
+    def obs_result() -> dict | None:
+        return (
+            {"device_counters": ctr_total} if ctr_total is not None else None
+        )
+
     while True:
+        t_disp = ev.now_us()
         with sguard.step():
             out = program.step(state)
-        state, tree_inc, sol_inc, cycles = program.read(out)
+        state, tree_inc, sol_inc, cycles, ctr = program.read(out)
         tree2 += tree_inc
         sol2 += sol_inc
         diagnostics.kernel_launches += cycles
         size = int(state[-2])
         best = int(state[-1])
+        if ctr is not None:
+            ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        if ev.enabled():
+            ev.complete("dispatch", t_disp, args={
+                "cycles": cycles, "tree": tree_inc, "sol": sol_inc,
+                "size": size, "best": best,
+            })
+            if ctr is not None:
+                ev.counter("device_counters", **obs_counters.as_args(ctr))
+            if best < prev_best:
+                ev.emit("incumbent", args={"best": best})
+        prev_best = best
         if size < m:
             break
         if controller.after_step(tree1 + tree2, sol1 + sol2):
             t2 = time.perf_counter()
             phases.append(PhaseStats(t2 - t1, tree2, sol2))
+            ev.emit("checkpoint", args={"cutoff": True})
+            _emit_device_explored(ctr_total, tree2, sol2, fb_tree, fb_sol)
             return SearchResult(
                 explored_tree=tree1 + tree2,
                 explored_sol=sol1 + sol2,
@@ -650,11 +728,14 @@ def resident_search(
                 phases=phases,
                 diagnostics=diagnostics,
                 complete=False,
+                obs=obs_result(),
             )
         if cycles == 0:
             # Capacity stall: pool too full for another device fan-out. Run
             # classic offload cycles through a host pool until there is
             # headroom again (rare; guarantees progress at any capacity).
+            t_fb = ev.now_us()
+            fb_tree0, fb_sol0 = tree2, sol2
             batch, size, best = program.residual(state)
             diagnostics.device_to_host += 1
             pool.reset_from(batch)
@@ -685,11 +766,17 @@ def resident_search(
             # The re-upload is a sanctioned host round trip; the next
             # dispatch is a fresh warm one for the guard.
             sguard.rearm()
+            fb_tree += tree2 - fb_tree0
+            fb_sol += sol2 - fb_sol0
+            ev.complete("overflow_fallback", t_fb, args={
+                "tree": tree2 - fb_tree0, "sol": sol2 - fb_sol0,
+            })
     batch, size, best = program.residual(state)
     diagnostics.device_to_host += 1
     pool.reset_from(batch)
     t2 = time.perf_counter()
     phases.append(PhaseStats(t2 - t1, tree2, sol2))
+    _emit_device_explored(ctr_total, tree2, sol2, fb_tree, fb_sol)
 
     # -- phase 3: host drain ----------------------------------------------
     from .device import drain
@@ -697,6 +784,7 @@ def resident_search(
     tree3, sol3, best = drain(problem, pool, best)
     t3 = time.perf_counter()
     phases.append(PhaseStats(t3 - t2, tree3, sol3))
+    ev.counter("explored", tree=tree3, sol=sol3, phase=3)
 
     return SearchResult(
         explored_tree=tree1 + tree2 + tree3,
@@ -705,4 +793,5 @@ def resident_search(
         elapsed=t3 - t0,
         phases=phases,
         diagnostics=diagnostics,
+        obs=obs_result(),
     )

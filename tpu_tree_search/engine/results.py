@@ -51,6 +51,12 @@ class SearchResult:
     # dist tier: inter-host communicator totals (exchange rounds, stolen
     # blocks/nodes), summed across hosts.
     comm: dict | None = None
+    # Telemetry snapshot (TTS_OBS=1, docs/OBSERVABILITY.md): per-run totals
+    # of the on-device counter block harvested at dispatch boundaries
+    # ({"device_counters": {popped, pushed, leaves, pruned, overflow,
+    # pool_hwm, surv_hwm}}). None when obs is off — the default-off path
+    # carries no cost and no payload.
+    obs: dict | None = None
 
     def workload_shares(self) -> list[float]:
         """Per-worker share of explored nodes (load-balance report,

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import time
 
+from ..obs import events as ev
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
 from .results import PhaseStats, SearchResult
@@ -26,6 +27,7 @@ def sequential_search(problem: Problem, initial_best: int | None = None) -> Sear
     if native is not None:
         tree, sol, best = native
         elapsed = time.perf_counter() - t0
+        ev.counter("explored", tree=tree, sol=sol, phase=1)
         return SearchResult(
             explored_tree=tree,
             explored_sol=sol,
@@ -52,6 +54,7 @@ def sequential_search(problem: Problem, initial_best: int | None = None) -> Sear
         for i in range(n):
             pool.push_back(index_batch(res.children, i))
     elapsed = time.perf_counter() - t0
+    ev.counter("explored", tree=tree, sol=sol, phase=1)
 
     return SearchResult(
         explored_tree=tree,

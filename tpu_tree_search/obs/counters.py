@@ -1,0 +1,119 @@
+"""On-device cycle counters for the resident engines (``TTS_OBS=1``).
+
+The reference ships always-on diagnostics counters and per-run stats lines
+(SURVEY.md §4, `pfsp_gpu_cuda.c:140-148`); the resident engines here run up
+to K chunk cycles inside one jitted ``lax.while_loop``, so per-cycle
+dynamics (pool occupancy, prune rates, overflow fallbacks) are invisible to
+the host by design. This module adds a small **fixed-shape counter block**
+to the loop carry — accumulated with pure jnp ops inside the traced body,
+harvested only at the existing K-cycle dispatch boundaries where the host
+already reads the tree/sol/cycles scalars. Steady state stays transfer-free
+and recompile-free: the block rides the same dispatch result the engine
+reads anyway, so ``TTS_GUARD=1`` sees nothing new.
+
+Zero-cost disabled path: enablement is decided at **program build time**
+(``device_counters_enabled()``, baked into the engines' program cache
+keys). When off, the carry, the body, and the jaxpr are byte-identical to a
+build without this module — counters are compiled out, not branched
+(tests/test_obs.py pins this).
+
+Slot semantics (``SLOTS`` order; all int32, reset each dispatch):
+
+  * ``popped``      — parents popped (sum of per-cycle ``cnt``);
+  * ``pushed``      — children pushed (== exploredTree increments);
+  * ``leaves``      — solution leaves counted (== exploredSol increments);
+  * ``pruned``      — candidate child slots not pushed and not leaves:
+                      ``cnt * child_slots - pushed - leaves`` (includes the
+                      structurally-closed slots of deep PFSP parents — the
+                      bound-cut vs closed split is not observable from the
+                      body without re-deriving the evaluator's masks);
+  * ``overflow``    — cycles that took the full-scatter fallback (survivors
+                      exceeded the compaction budget S);
+  * ``pool_hwm``    — high-water mark of the pool size after the push;
+  * ``surv_hwm``    — high-water mark of per-cycle survivors (``tree_inc``).
+
+Counter headroom rides the engines' existing K clamp (``K*M*n < 2^31`` per
+dispatch); the host accumulates across dispatches in Python ints.
+"""
+
+from __future__ import annotations
+
+import os
+
+SLOTS = (
+    "popped",
+    "pushed",
+    "leaves",
+    "pruned",
+    "overflow",
+    "pool_hwm",
+    "surv_hwm",
+)
+NSLOTS = len(SLOTS)
+
+#: SLOTS index lookup, e.g. ``IDX["pushed"]``.
+IDX = {name: i for i, name in enumerate(SLOTS)}
+
+#: Slots accumulated as running maxima (the rest add).
+_MAX_SLOTS = frozenset((IDX["pool_hwm"], IDX["surv_hwm"]))
+
+
+def device_counters_enabled() -> bool:
+    """True only for ``TTS_OBS=1`` (full mode). ``TTS_OBS=host`` records
+    host events but leaves every device program untouched."""
+    return os.environ.get("TTS_OBS", "0") == "1"
+
+
+def init_block():
+    """Fresh all-zeros counter block — the dispatch-local carry leaf."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((NSLOTS,), jnp.int32)
+
+
+# tts-lint: traced (called from the resident while-loop body when TTS_OBS=1)
+def update(ctr, cnt, n: int, tree_inc, sol_inc, fits, size):
+    """One cycle's accumulation: pure elementwise jnp on a (NSLOTS,) int32
+    vector. ``cnt``/``tree_inc``/``sol_inc``/``size`` are traced scalars
+    from the loop body, ``fits`` the small-path predicate, ``n`` the static
+    child-slot count."""
+    import jax.numpy as jnp
+
+    inc = jnp.stack([
+        cnt,
+        tree_inc,
+        sol_inc,
+        cnt * n - tree_inc - sol_inc,
+        jnp.where(fits, 0, 1).astype(jnp.int32),
+        jnp.int32(0),
+        jnp.int32(0),
+    ])
+    hwm = jnp.stack([
+        jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+        jnp.int32(0), size, tree_inc,
+    ])
+    return jnp.maximum(ctr + inc, hwm)
+
+
+def merge_host(total: dict | None, block) -> dict:
+    """Host-side accumulation of one harvested block (np array, possibly
+    (D, NSLOTS) for the mesh tiers) into a running totals dict — adds the
+    additive slots, maxes the high-water marks."""
+    import numpy as np
+
+    arr = np.asarray(block, dtype=np.int64).reshape(-1, NSLOTS)
+    out = dict(total) if total else {name: 0 for name in SLOTS}
+    for i, name in enumerate(SLOTS):
+        col = arr[:, i]
+        if i in _MAX_SLOTS:
+            out[name] = max(out[name], int(col.max()))
+        else:
+            out[name] = out[name] + int(col.sum())
+    return out
+
+
+def as_args(block) -> dict:
+    """A harvested block as a {slot: int} dict for counter events and
+    metrics lines (multi-shard blocks sum the additive slots and max the
+    high-water marks, like ``merge_host``)."""
+    return merge_host(None, block)

@@ -1,0 +1,174 @@
+"""Host-side structured event tracing (``TTS_OBS``).
+
+The reference kit's only observability is the final banner plus an appended
+``stats_*.dat`` line (`pfsp_gpu_cuda.c:140-148`); the dynamics both
+load-balancing papers diagnose from — steal rounds, idle windows, per-worker
+imbalance (Helbecque et al., arXiv:2012.09511 §5; Melab et al.,
+arXiv:0809.3285 §4) — are invisible. This module records them: a process-wide
+recorder of timestamped structured events that the runtimes emit at their
+natural host-side boundaries (dispatches, steals, exchange rounds, incumbent
+improvements, phase transitions, checkpoint cuts).
+
+Concurrency model: **thread-local append buffers, merged at drain**. Workers
+(the multi/dist tiers run one host thread per device plus communicator
+threads) append to their own bounded deque without taking any lock; the
+recorder's lock guards only the buffer *registry* (taken once per thread,
+at first emit) and the drain-time merge. No hot-path contention, no
+cross-thread ordering requirement — events carry monotonic timestamps
+(``time.perf_counter_ns``) and the merge sorts.
+
+Cost model: every emit is gated on ``enabled()`` — one global read — so the
+disabled path is a few nanoseconds per call site. Call sites are host-side
+control points (per dispatch / steal / round), never per node or per cycle;
+the on-device hot loop is covered by ``counters`` instead.
+
+Event shape (Chrome-trace-event aligned, so export is a dump not a
+translation): ``ph`` is the Chrome phase — ``"i"`` instant, ``"X"`` complete
+(with ``dur``), ``"C"`` counter — ``ts``/``dur`` are microseconds, ``pid``
+is the host id, ``tid`` the worker/communicator track.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+#: Per-thread buffer bound: a runaway run (TTS_OBS=1 with nobody draining)
+#: keeps the newest events instead of growing without bound.
+MAX_EVENTS_PER_THREAD = 200_000
+
+#: tid used for communicator/coordinator tracks (clear of worker ids).
+COMM_TID = 1000
+
+
+def obs_mode() -> str:
+    """The ``TTS_OBS`` knob: ``"0"``/unset = off, ``"1"`` = full (host
+    events + on-device counters), ``"host"`` = host events only — the
+    device programs stay byte-identical to obs-off, so a run can be traced
+    without recompiling its resident step (bench uses this to attach the
+    headline trace without perturbing the measurement)."""
+    return os.environ.get("TTS_OBS", "0") or "0"
+
+
+def enabled() -> bool:
+    """Host event tracing on? (Any non-off mode.)"""
+    return obs_mode() not in ("0",)
+
+
+def now_us() -> float:
+    """Monotonic microseconds — the trace time base."""
+    return time.perf_counter_ns() / 1e3
+
+
+class EventRecorder:
+    """Thread-local buffers + locked registry; see module docstring."""
+
+    def __init__(self, max_per_thread: int = MAX_EVENTS_PER_THREAD):
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._buffers: list[deque] = []  # guarded-by: _lock
+        self._max = max_per_thread
+
+    def _buf(self) -> deque:
+        buf = getattr(self._local, "buf", None)
+        if buf is None:
+            buf = deque(maxlen=self._max)
+            self._local.buf = buf
+            with self._lock:
+                self._buffers.append(buf)
+        return buf
+
+    def emit(self, event: dict) -> None:
+        self._buf().append(event)
+
+    def drain(self) -> list[dict]:
+        """Merged, time-sorted snapshot of every thread's buffer."""
+        with self._lock:
+            merged = [e for buf in self._buffers for e in list(buf)]
+        merged.sort(key=lambda e: e.get("ts", 0.0))
+        return merged
+
+    def clear(self) -> None:
+        with self._lock:
+            for buf in self._buffers:
+                buf.clear()
+
+
+_recorder = EventRecorder()
+
+
+def recorder() -> EventRecorder:
+    return _recorder
+
+
+def reset() -> None:
+    """Empty every buffer (run-scoped captures call this on entry so one
+    process's earlier runs don't leak into a new trace)."""
+    _recorder.clear()
+
+
+def drain() -> list[dict]:
+    return _recorder.drain()
+
+
+def emit(name: str, cat: str = "tts", ph: str = "i", wid: int = 0,
+         host: int = 0, ts: float | None = None, dur: float | None = None,
+         args: dict | None = None) -> None:
+    """Record one event iff tracing is enabled (cheap no-op otherwise)."""
+    if not enabled():
+        return
+    ev: dict = {
+        "name": name,
+        "cat": cat,
+        "ph": ph,
+        "ts": now_us() if ts is None else ts,
+        "pid": host,
+        "tid": wid,
+    }
+    if dur is not None:
+        ev["dur"] = dur
+    if args:
+        ev["args"] = args
+    _recorder.emit(ev)
+
+
+def complete(name: str, start_us: float, cat: str = "tts", wid: int = 0,
+             host: int = 0, args: dict | None = None) -> None:
+    """A Chrome ``"X"`` complete event spanning ``start_us`` .. now."""
+    if not enabled():
+        return
+    emit(name, cat=cat, ph="X", wid=wid, host=host, ts=start_us,
+         dur=max(0.0, now_us() - start_us), args=args)
+
+
+def counter(name: str, wid: int = 0, host: int = 0, **values) -> None:
+    """A Chrome ``"C"`` counter sample (one Perfetto counter track per
+    name); values must be numbers."""
+    if not enabled():
+        return
+    emit(name, cat="metrics", ph="C", wid=wid, host=host, args=values)
+
+
+class span:
+    """``with span("steal", wid=3):`` — emits one complete event covering
+    the block. Usable when tracing is off (no-op)."""
+
+    def __init__(self, name: str, cat: str = "tts", wid: int = 0,
+                 host: int = 0, args: dict | None = None):
+        self.name = name
+        self.cat = cat
+        self.wid = wid
+        self.host = host
+        self.args = args
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = now_us()
+        return self
+
+    def __exit__(self, *exc):
+        complete(self.name, self._t0, cat=self.cat, wid=self.wid,
+                 host=self.host, args=self.args)
+        return False

@@ -1,0 +1,94 @@
+"""Trace and metrics exporters.
+
+Two artifact formats, both written from the same drained event list:
+
+  * **Chrome trace event JSON** (``write_chrome_trace``) — the
+    ``{"traceEvents": [...]}`` object format, loadable in Perfetto
+    (ui.perfetto.dev) or ``chrome://tracing``. One process row per host,
+    one thread track per worker (plus a communicator track), counter
+    events as counter tracks. Events are already recorded in this shape
+    (``events.py``), so export is metadata + dump, not translation.
+  * **metrics JSON lines** (``write_metrics_jsonl``) — one flat JSON
+    object per counter sample (``ph == "C"``), suitable for scraping /
+    `jq` / pandas; the machine-readable companion of the reference's
+    appended ``stats_*.dat`` lines (`pfsp_gpu_cuda.c:140-148`).
+"""
+
+from __future__ import annotations
+
+import json
+
+from .events import COMM_TID
+
+
+def _track_name(tid: int) -> str:
+    if tid == COMM_TID:
+        return "communicator"
+    return f"worker{tid}"
+
+
+def chrome_trace_object(evts: list[dict], label: str = "tts") -> dict:
+    """The full Chrome-trace object for a drained event list (metadata
+    process/thread-name records prepended for every (pid, tid) seen)."""
+    meta: list[dict] = []
+    pids = sorted({e.get("pid", 0) for e in evts})
+    tracks = sorted({(e.get("pid", 0), e.get("tid", 0)) for e in evts})
+    for pid in pids:
+        meta.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"{label} host{pid}"},
+        })
+    for pid, tid in tracks:
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": _track_name(tid)},
+        })
+    return {
+        "traceEvents": meta + evts,
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "tpu_tree_search obs"},
+    }
+
+
+def write_chrome_trace(evts: list[dict], path: str, label: str = "tts") -> int:
+    """Write the trace file; returns the event count (sans metadata)."""
+    with open(path, "w") as f:
+        json.dump(chrome_trace_object(evts, label=label), f)
+    return len(evts)
+
+
+def load_trace(path: str) -> list[dict]:
+    """Read back a trace file (either the object format this module writes
+    or a bare event array) minus metadata records."""
+    with open(path) as f:
+        obj = json.load(f)
+    evts = obj["traceEvents"] if isinstance(obj, dict) else obj
+    return [e for e in evts if e.get("ph") != "M"]
+
+
+def metrics_lines(evts: list[dict]) -> list[dict]:
+    """Flatten counter samples to scrape-ready records."""
+    out = []
+    for e in evts:
+        if e.get("ph") != "C":
+            continue
+        rec = {
+            "ts_us": e.get("ts", 0.0),
+            "name": e.get("name", ""),
+            "host": e.get("pid", 0),
+            "worker": e.get("tid", 0),
+        }
+        rec.update(e.get("args") or {})
+        out.append(rec)
+    return out
+
+
+def write_metrics_jsonl(evts: list[dict], path: str) -> int:
+    """Append one JSON line per counter sample; returns the line count.
+    Append mode on purpose — like the reference's ``--stats-file``, repeat
+    runs accumulate into one scrapeable file."""
+    lines = metrics_lines(evts)
+    with open(path, "a") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    return len(lines)

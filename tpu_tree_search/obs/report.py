@@ -1,0 +1,206 @@
+"""``tts report <trace>`` — summarize a recorded trace.
+
+Consumes the Chrome-trace JSON written by ``--trace`` (or a drained event
+list) and prints the three summaries the load-balancing literature reads
+off exactly this kind of per-round telemetry (Helbecque et al.,
+arXiv:2012.09511; Melab et al., arXiv:0809.3285):
+
+  * **steal efficiency** — successful steals / attempts, nodes moved,
+    plus the inter-host donation and exchange-round totals;
+  * **idle fraction per worker** — recorded idle spans over the trace
+    span, the direct per-worker imbalance metric;
+  * **cycle-rate timeline** — bucketed device cycles/sec and explored
+    nodes/sec over the run, from the per-dispatch events.
+
+All three sections always print (zeros / "none recorded" when a tier has
+no such events) so downstream tooling can parse unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .events import COMM_TID
+
+
+def _span_us(evts: list[dict]) -> tuple[float, float]:
+    if not evts:
+        return 0.0, 0.0
+    t0 = min(e.get("ts", 0.0) for e in evts)
+    t1 = max(e.get("ts", 0.0) + e.get("dur", 0.0) for e in evts)
+    return t0, t1
+
+
+def summarize(evts: list[dict], buckets: int = 10) -> dict:
+    """Structured summary of a drained/loaded event list."""
+    t0, t1 = _span_us(evts)
+    span_s = max(t1 - t0, 0.0) / 1e6
+
+    # -- steal / donation efficiency --------------------------------------
+    steals = [e for e in evts if e.get("name") == "steal"]
+    misses = [e for e in evts if e.get("name") == "steal_miss"]
+    attempts = len(steals) + len(misses)
+    stolen_nodes = sum((e.get("args") or {}).get("nodes", 0) for e in steals)
+    sends = [e for e in evts if e.get("name") == "donate_send"]
+    recvs = [e for e in evts if e.get("name") == "donate_recv"]
+    rounds = sum(1 for e in evts if e.get("name") == "exchange")
+    steal = {
+        "attempts": attempts,
+        "successes": len(steals),
+        "efficiency": (len(steals) / attempts) if attempts else None,
+        "nodes_moved": stolen_nodes,
+        "interhost_blocks_sent": len(sends),
+        "interhost_nodes_sent": sum(
+            (e.get("args") or {}).get("nodes", 0) for e in sends
+        ),
+        "interhost_blocks_received": len(recvs),
+        "exchange_rounds": rounds,
+    }
+
+    # -- idle fraction per worker -----------------------------------------
+    workers: dict[str, dict] = {}
+    for e in evts:
+        tid = e.get("tid", 0)
+        if tid == COMM_TID:
+            continue
+        key = f"h{e.get('pid', 0)}/w{tid}"
+        w = workers.setdefault(key, {"idle_us": 0.0, "busy_us": 0.0})
+        if e.get("name") == "idle":
+            w["idle_us"] += e.get("dur", 0.0)
+        elif e.get("name") in ("dispatch", "chunk") and "dur" in e:
+            w["busy_us"] += e["dur"]
+    idle = {
+        key: {
+            "idle_fraction": (w["idle_us"] / (t1 - t0)) if t1 > t0 else 0.0,
+            "busy_fraction": (w["busy_us"] / (t1 - t0)) if t1 > t0 else 0.0,
+        }
+        for key, w in sorted(workers.items())
+    }
+
+    # -- cycle-rate timeline ----------------------------------------------
+    # Resident tiers emit per-dispatch spans; the offload tiers (multi/
+    # dist workers) emit per-chunk spans instead — use whichever exists so
+    # every tier gets a rate timeline (chunk events carry no device cycle
+    # count; their cycles contribution is 0).
+    dispatches = [e for e in evts if e.get("name") == "dispatch"]
+    if not dispatches:
+        dispatches = [e for e in evts if e.get("name") == "chunk"]
+    timeline = []
+    if dispatches and t1 > t0:
+        nb = min(buckets, max(1, len(dispatches)))
+        width = (t1 - t0) / nb
+        acc = [{"cycles": 0, "nodes": 0, "dispatches": 0} for _ in range(nb)]
+        for e in dispatches:
+            # Attribute at completion: the counters were harvested then.
+            end = e.get("ts", 0.0) + e.get("dur", 0.0)
+            b = min(nb - 1, int((end - t0) / width))
+            a = e.get("args") or {}
+            acc[b]["cycles"] += a.get("cycles", 0)
+            acc[b]["nodes"] += a.get("tree", 0)
+            acc[b]["dispatches"] += 1
+        for i, a in enumerate(acc):
+            sec = width / 1e6
+            timeline.append({
+                "t_s": round(i * width / 1e6, 3),
+                "cycles_per_sec": round(a["cycles"] / sec, 1),
+                "nodes_per_sec": round(a["nodes"] / sec, 1),
+                "dispatches": a["dispatches"],
+            })
+
+    counters_total: dict = {}
+    for e in evts:
+        if e.get("name") == "device_counters":
+            for k, v in (e.get("args") or {}).items():
+                if k in ("pool_hwm", "surv_hwm"):
+                    counters_total[k] = max(counters_total.get(k, 0), v)
+                else:
+                    counters_total[k] = counters_total.get(k, 0) + v
+
+    return {
+        "events": len(evts),
+        "span_s": round(span_s, 6),
+        "hosts": len({e.get("pid", 0) for e in evts}),
+        "steal": steal,
+        "idle": idle,
+        "cycle_rate": timeline,
+        "device_counters": counters_total,
+    }
+
+
+def render(summary: dict) -> str:
+    """Human-readable report text."""
+    out = []
+    out.append(
+        f"trace: {summary['events']} events over {summary['span_s']:.3f}s "
+        f"across {summary['hosts']} host(s)"
+    )
+    s = summary["steal"]
+    if s["attempts"]:
+        eff = 100.0 * s["efficiency"]
+        out.append(
+            f"steal efficiency: {s['successes']}/{s['attempts']} attempts "
+            f"({eff:.1f}%), {s['nodes_moved']} nodes moved"
+        )
+    else:
+        out.append("steal efficiency: no steal attempts recorded")
+    out.append(
+        f"inter-host: {s['exchange_rounds']} exchange round(s), "
+        f"{s['interhost_blocks_sent']} block(s) / "
+        f"{s['interhost_nodes_sent']} node(s) donated"
+    )
+    out.append("idle fraction per worker:")
+    if summary["idle"]:
+        for key, w in summary["idle"].items():
+            out.append(
+                f"  {key}: idle {100.0 * w['idle_fraction']:5.1f}%  "
+                f"busy {100.0 * w['busy_fraction']:5.1f}%"
+            )
+    else:
+        out.append("  no worker tracks recorded")
+    out.append("cycle-rate timeline:")
+    if summary["cycle_rate"]:
+        for b in summary["cycle_rate"]:
+            out.append(
+                f"  t={b['t_s']:8.3f}s  {b['cycles_per_sec']:12.1f} cyc/s  "
+                f"{b['nodes_per_sec']:14.1f} nodes/s  "
+                f"({b['dispatches']} dispatch(es))"
+            )
+    else:
+        out.append("  no dispatch events recorded")
+    if summary["device_counters"]:
+        c = summary["device_counters"]
+        out.append(
+            "device counters: "
+            + "  ".join(f"{k}={v}" for k, v in sorted(c.items()))
+        )
+    return "\n".join(out)
+
+
+def report_main(trace_path: str, as_json: bool = False) -> int:
+    """The ``tts report`` entry point."""
+    from .export import load_trace
+
+    try:
+        evts = load_trace(trace_path)
+    except (OSError, ValueError, KeyError) as e:
+        import sys
+
+        print(f"Error: cannot read trace {trace_path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    summary = summarize(evts)
+    try:
+        if as_json:
+            print(json.dumps(summary))
+        else:
+            print(render(summary))
+    except BrokenPipeError:
+        # `tts report t.json | head` closing the pipe is not an error.
+        import os
+        import sys
+
+        try:
+            sys.stdout.close()
+        except Exception:
+            os._exit(0)
+    return 0

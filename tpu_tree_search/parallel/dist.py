@@ -39,6 +39,7 @@ import threading
 import numpy as np
 
 from ..engine.results import SearchResult
+from ..obs import events as ev
 from ..pool import ParallelSoAPool
 from ..problems.base import Problem
 from .multidevice import host_pipeline
@@ -405,6 +406,13 @@ class _HostComm:
             )
             gbest = min(r[2] for r in rows)
             shared.publish(gbest)
+            ev.emit("exchange", wid=ev.COMM_TID, host=me, args={
+                "round": self.rounds, "size": size, "best": int(gbest),
+                "idle": bool(idle), "backoff": backoff,
+            })
+            if gbest < best:
+                ev.emit("incumbent", wid=ev.COMM_TID, host=me,
+                        args={"best": int(gbest)})
             sizes = [r[0] for r in rows]
             maxes = [r[1] for r in rows]
             idles = [r[3] for r in rows]
@@ -429,6 +437,8 @@ class _HostComm:
                     # its poppable work diverted to the serial host drain.
                     quiescent_streak += 1
                     if quiescent_streak >= 2:
+                        ev.emit("terminate", wid=ev.COMM_TID, host=me,
+                                args={"round": self.rounds})
                         stop_event.set()
                         return
                     backoff = 1  # confirm promptly
@@ -461,6 +471,10 @@ class _HostComm:
                     if payload is not None:
                         self.blocks_sent += 1
                         self.nodes_sent += batch_length(payload)
+                        ev.emit("donate_send", wid=ev.COMM_TID, host=me,
+                                args={"peer": send_to,
+                                      "nodes": batch_length(payload),
+                                      "round": self.rounds})
                 if recv_from is not None:
                     batch = pickle.loads(
                         coll.kv_get(
@@ -476,6 +490,10 @@ class _HostComm:
                         rrobin = (rrobin + 1) % len(pools)
                         self.blocks_received += 1
                         self.nodes_received += batch_length(batch)
+                        ev.emit("donate_recv", wid=ev.COMM_TID, host=me,
+                                args={"peer": recv_from,
+                                      "nodes": batch_length(batch),
+                                      "round": self.rounds})
             if do_ckpt:
                 # Same round on every host (rows[0][4]): donations above
                 # completed, workers pause at chunk boundaries, each host

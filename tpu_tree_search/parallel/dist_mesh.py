@@ -37,7 +37,10 @@ import time
 import numpy as np
 
 from ..engine.device import drain, warmup
+from ..engine.resident import _emit_device_explored
 from ..engine.results import Diagnostics, PhaseStats, SearchResult
+from ..obs import counters as obs_counters
+from ..obs import events as ev
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
 from .dist import (
@@ -122,6 +125,7 @@ def _host_loop(
             if me != 0:
                 tree1 = sol1 = 0
     t1 = time.perf_counter()
+    ev.counter("explored", host=me, tree=tree1, sol=sol1, phase=1)
 
     # -- phase 2: per-host SPMD loop + step-boundary exchanges --------------
     from ..engine.resident import resolve_capacity
@@ -138,6 +142,7 @@ def _host_loop(
         tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
         m, M, K, rounds, T, capacity,
         routing_cache_token(problem, mesh.devices.flat[0]),
+        obs_counters.device_counters_enabled(),
     )
     program = cache.get(key)
     if program is None:
@@ -196,6 +201,7 @@ def _host_loop(
     def do_lockstep_cut(tag) -> None:
         staging = eff_ckpt + ".staging"
         ok = True
+        t_cut = ev.now_us()
         try:
             batch = program.full_batch(state)
             diagnostics.device_to_host += 1
@@ -207,17 +213,37 @@ def _host_loop(
             ok, staging, eff_ckpt,
             vote=coll.allgather_obj if H > 1 else None,
         )
+        ev.complete("checkpoint", t_cut, wid=ev.COMM_TID, host=me,
+                    args={"tag": str(tag), "ok": ok})
+
+    ctr_total: dict | None = None
+    prev_best = best
 
     while True:
+        t_disp = ev.now_us()
         with sguard.step():
             out = program.step(state)
-        state, ti, si, cy, sizes, best, tree_vec = program.read_stats(out)
+        state, ti, si, cy, sizes, best, tree_vec, ctr = \
+            program.read_stats(out)
         tree2 += ti
         sol2 += si
         per_worker += tree_vec.astype(np.int64)
         diagnostics.kernel_launches += cy
         steps += 1
         total = int(sizes.sum())
+        if ctr is not None:
+            ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        if ev.enabled():
+            ev.complete("dispatch", t_disp, host=me, args={
+                "cycles": cy, "tree": ti, "sol": si, "size": total,
+                "best": int(best), "shard_sizes": sizes.tolist(),
+            })
+            if ctr is not None:
+                ev.counter("device_counters", host=me,
+                           **obs_counters.as_args(ctr))
+            if best < prev_best:
+                ev.emit("incumbent", host=me, args={"best": int(best)})
+        prev_best = best
         # Idle = this host's mesh cannot run another chunk cycle anywhere.
         idle = int(sizes.max()) < m
         if max_steps is not None and steps >= max_steps:
@@ -251,6 +277,10 @@ def _host_loop(
             (total, bool(idle), int(best), want_ckpt, cut_id)
         )
         gbest = min(r[2] for r in rows)
+        ev.emit("exchange", wid=ev.COMM_TID, host=me, args={
+            "round": exch_rounds, "size": total, "best": int(gbest),
+            "idle": bool(idle),
+        })
         if gbest < best:
             # Inject the global incumbent into the sharded state: the best
             # vector is a tiny (D,) array — replace it in place with the
@@ -281,6 +311,8 @@ def _host_loop(
         if all(idles) and not pairs:
             quiescent_streak += 1
             if quiescent_streak >= 2:
+                ev.emit("terminate", wid=ev.COMM_TID, host=me,
+                        args={"round": exch_rounds})
                 break
             continue
         quiescent_streak = 0
@@ -303,6 +335,10 @@ def _host_loop(
             if block is not None:
                 blocks_sent += 1
                 nodes_sent += batch_length(block)
+                ev.emit("donate_send", wid=ev.COMM_TID, host=me,
+                        args={"peer": send_to,
+                              "nodes": batch_length(block),
+                              "round": exch_rounds})
         if recv_from is not None:
             block = pickle.loads(
                 coll.kv_get(
@@ -316,6 +352,10 @@ def _host_loop(
                 upload(p)
                 blocks_received += 1
                 nodes_received += batch_length(block)
+                ev.emit("donate_recv", wid=ev.COMM_TID, host=me,
+                        args={"peer": recv_from,
+                              "nodes": batch_length(block),
+                              "round": exch_rounds})
         if idle and recv_from is None and exchange_sleep_s:
             time.sleep(exchange_sleep_s)
 
@@ -324,8 +364,10 @@ def _host_loop(
     diagnostics.device_to_host += 1
     pool.reset_from(batch)
     t2 = time.perf_counter()
+    _emit_device_explored(ctr_total, tree2, sol2, 0, 0, host=me)
     tree3, sol3, best = drain(problem, pool, best)
     t3 = time.perf_counter()
+    ev.counter("explored", host=me, tree=tree3, sol=sol3, phase=3)
 
     return {
         "tree": tree1 + tree2 + tree3,
@@ -348,6 +390,10 @@ def _host_loop(
             "nodes_received": nodes_received,
         },
         "complete": completed,
+        # Host-local counter totals (not reduced — per-host telemetry).
+        "obs": (
+            {"device_counters": ctr_total} if ctr_total is not None else None
+        ),
     }
 
 
@@ -364,6 +410,7 @@ def _reduce(local: dict, coll) -> SearchResult:
         steals=coll.allreduce_sum(local["steals"]),
         comm=comm,
         complete=bool(coll.allreduce_min(int(local["complete"]))),
+        obs=local.get("obs"),
     )
 
 

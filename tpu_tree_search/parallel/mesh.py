@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs import events as ev
 from ..problems.base import INF_BOUND
 
 
@@ -69,7 +70,12 @@ class MeshEvaluator:
         self.mesh = mesh
         self.dp = mesh.shape["dp"]
         self.mp = mesh.shape["mp"]
+        t_build = ev.now_us()
         self._step = self._build(problem, mesh)
+        ev.complete("build", t_build, cat="compile", args={
+            "program": "mesh_chunk_step", "problem": problem.name,
+            "dp": int(self.dp), "mp": int(self.mp),
+        })
 
     # -- construction ------------------------------------------------------
 
@@ -183,7 +189,10 @@ class MeshEvaluator:
 
     def __call__(self, parents, count, best):
         _, run = self._step
-        return run(parents, count, best)
+        t0 = ev.now_us()
+        out = run(parents, count, best)
+        ev.complete("chunk", t0, args={"count": int(count)})
+        return out
 
 
 def _fold_leaf_best(parents, bounds, best, jobs, count):

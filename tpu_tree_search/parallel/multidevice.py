@@ -33,8 +33,9 @@ import numpy as np
 
 from ..engine.device import DeviceOffloader, bucket_size, drain, warmup
 from ..engine.results import Diagnostics, PhaseStats, SearchResult
+from ..obs import events as ev
 from ..pool import ParallelSoAPool, SoAPool
-from ..problems.base import INF_BOUND, Problem, index_batch
+from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
 from ..utils import TaskStates
 
 
@@ -137,6 +138,7 @@ class CheckpointManager:
         to a temp file for its collective two-phase commit."""
         from ..engine import checkpoint as ckpt
 
+        t_cut = ev.now_us()
         self.gate.pause()
         try:
             # Re-check AFTER the rendezvous: a worker that crashed while
@@ -159,6 +161,8 @@ class CheckpointManager:
             )
             ckpt.save(to_path or self.path, self.problem, batch, best, tree,
                       sol, hosts=self.hosts, cut_tag=cut_tag)
+            ev.complete("checkpoint", t_cut, wid=ev.COMM_TID,
+                        args={"nodes": int(batch_length(batch))})
             return True
         finally:
             self.gate.resume()
@@ -222,8 +226,10 @@ def _worker_loop(
     perc: float = 0.5,
     stop_event: threading.Event | None = None,
     gate: PauseGate | None = None,
+    host_id: int = 0,
 ):
     problem = w.problem
+    idle_t0: float | None = None  # open idle span start (obs tracing)
     try:
         off = DeviceOffloader(problem, w.device)
         w.diagnostics = off.diagnostics
@@ -242,6 +248,10 @@ def _worker_loop(
             states.set_busy(w.wid)
             count = w.pool.locked_pop_back_bulk(m, M, chunk_buf)
             if count > 0:
+                if idle_t0 is not None:
+                    ev.complete("idle", idle_t0, wid=w.wid, host=host_id)
+                    idle_t0 = None
+                t_chunk = ev.now_us()
                 if shared is not None:
                     w.best = min(w.best, shared.read())
                 bucket = bucket_size(count, m, M)
@@ -255,7 +265,12 @@ def _worker_loop(
                     w.best = res.best
                     if shared is not None:
                         w.best = shared.publish(w.best)
+                    ev.emit("incumbent", wid=w.wid, host=host_id,
+                            args={"best": w.best})
                 w.pool.locked_push_back_bulk(res.children)
+                ev.complete("chunk", t_chunk, wid=w.wid, host=host_id,
+                            args={"count": count, "tree": res.tree_inc,
+                                  "sol": res.sol_inc})
                 continue
             # -- work stealing (`pfsp_multigpu_chpl.chpl:438-479`) ---------
             stolen = False
@@ -273,6 +288,9 @@ def _worker_loop(
                             w.pool.locked_push_back_bulk(batch)
                             w.steals += 1
                             stolen = True
+                            ev.emit("steal", wid=w.wid, host=host_id,
+                                    args={"victim": int(victim_id),
+                                          "nodes": batch_length(batch)})
                         break
                     time.sleep(0)  # yieldExecution backoff
                 if stolen:
@@ -282,6 +300,12 @@ def _worker_loop(
                 continue
             # -- termination (`pfsp_multigpu_chpl.chpl:481-495`) -----------
             states.set_idle(w.wid)
+            if idle_t0 is None:
+                # One miss per busy->idle transition, not per spin
+                # iteration: the termination loop re-scans victims every
+                # few microseconds and would flood the trace.
+                ev.emit("steal_miss", wid=w.wid, host=host_id)
+                idle_t0 = ev.now_us()
             if stop_event is not None:
                 # Dist mode: local all-idle is NOT the end — the host may
                 # still receive stolen work from another host. Poll until
@@ -299,6 +323,10 @@ def _worker_loop(
         states.set_idle(w.wid)
         states.flag.set()  # unblock everyone; search aborts
     finally:
+        if idle_t0 is not None:
+            ev.complete("idle", idle_t0, wid=w.wid, host=host_id)
+        ev.counter("explored", wid=w.wid, host=host_id,
+                   tree=w.tree, sol=w.sol, phase=2)
         if gate is not None:
             gate.leave()
 
@@ -319,6 +347,7 @@ def run_workers(
     ckpt_interval_s: float = 60.0,
     ckpt_base: tuple[int, int] = (0, 0),
     ckpt_hosts: int = 1,
+    host_id: int = 0,
 ):
     """Step 2 of the multi-device tier: partition ``pool`` across D worker
     threads, run the offload/steal/terminate loops, join, and merge leftovers
@@ -361,7 +390,7 @@ def run_workers(
         threading.Thread(
             target=_worker_loop,
             args=(w, pools, states, m, M, shared, np.random.default_rng(s),
-                  perc, stop_event, gate),
+                  perc, stop_event, gate, host_id),
             name=f"tts-worker-{w.wid}",
         )
         for w, s in zip(workers, seeds.spawn(D))
@@ -490,6 +519,8 @@ def host_pipeline(
             if host_id != 0:
                 tree1 = sol1 = 0
     t1 = time.perf_counter()
+    ev.counter("explored", host=host_id, tree=base_tree + tree1,
+               sol=base_sol + sol1, phase=1)
 
     # -- step 2: partitioned parallel offload ------------------------------
     pool, tree2, sol2, best, workers = run_workers(
@@ -498,12 +529,14 @@ def host_pipeline(
         ckpt_path=eff_ckpt, ckpt_interval_s=checkpoint_interval_s,
         ckpt_base=(base_tree + tree1, base_sol + sol1),
         ckpt_hosts=num_hosts,
+        host_id=host_id,
     )
     t2 = time.perf_counter()
 
     # -- step 3: drain (`pfsp_multigpu_chpl.chpl:529-535`) -----------------
     tree3, sol3, best = drain(problem, pool, best)
     t3 = time.perf_counter()
+    ev.counter("explored", host=host_id, tree=tree3, sol=sol3, phase=3)
 
     diag = Diagnostics(
         kernel_launches=sum(w.diagnostics.kernel_launches for w in workers),

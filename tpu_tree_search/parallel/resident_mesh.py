@@ -41,8 +41,10 @@ import time
 import numpy as np
 
 from ..engine.device import drain, warmup
-from ..engine.resident import _make_program
+from ..engine.resident import _emit_device_explored, _make_program
 from ..engine.results import Diagnostics, PhaseStats, SearchResult
+from ..obs import counters as obs_counters
+from ..obs import events as ev
 from ..ops import pallas_kernels as PK
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, index_batch
@@ -144,6 +146,7 @@ class _MeshResidentProgram:
         aux_dt = self.inner.pool_fields[1][1]
         cond, body = self.inner.loop_fns(K)
         rounds = self.rounds
+        obs = self.inner.obs
         perm = [(i, (i + 1) % D) for i in range(D)]  # ring, static
 
         def shard_step(pool_vals, pool_aux, size, best):
@@ -155,13 +158,19 @@ class _MeshResidentProgram:
             tree = sz * 0
             sol = sz * 0
             cycles = sz * 0
+            if obs:
+                # Counter block accumulates across the dispatch's rounds
+                # (carried back in each round); varying like the scalars.
+                ctr = obs_counters.init_block() + (sz * 0)
             for _ in range(rounds):
-                carry = lax.while_loop(
-                    cond,
-                    body,
-                    (pool_vals, pool_aux, sz, bst, sz * 0, sz * 0, sz * 0),
-                )
-                pool_vals, pool_aux, sz, bst, ti, si, cy = carry
+                init = (pool_vals, pool_aux, sz, bst, sz * 0, sz * 0, sz * 0)
+                if obs:
+                    init = init + (ctr,)
+                carry = lax.while_loop(cond, body, init)
+                if obs:
+                    pool_vals, pool_aux, sz, bst, ti, si, cy, ctr = carry
+                else:
+                    pool_vals, pool_aux, sz, bst, ti, si, cy = carry
                 tree += ti
                 sol += si
                 cycles += cy
@@ -230,7 +239,7 @@ class _MeshResidentProgram:
                         pool_vals, pool_aux,
                     )
                     sz = sz + incoming
-            return (
+            out = (
                 pool_vals,
                 pool_aux,
                 sz[None],
@@ -239,17 +248,23 @@ class _MeshResidentProgram:
                 sol[None],
                 cycles[None],
             )
+            if obs:
+                out = out + (ctr[None],)
+            return out
 
         specs_pool = P(axis, None)
         specs_vec = P(axis)
+        out_specs = (
+            specs_pool, specs_vec, specs_vec, specs_vec,
+            specs_vec, specs_vec, specs_vec,
+        )
+        if obs:
+            out_specs = out_specs + (P(axis, None),)
         mapped = jax.shard_map(
             shard_step,
             mesh=mesh,
             in_specs=(specs_pool, specs_vec, specs_vec, specs_vec),
-            out_specs=(
-                specs_pool, specs_vec, specs_vec, specs_vec,
-                specs_vec, specs_vec, specs_vec,
-            ),
+            out_specs=out_specs,
             # pallas_call inside shard_map does not yet satisfy jax's vma
             # checker (out_shapes carry no vma; the kernel body mixes
             # varying batch blocks with replicated table blocks) — with the
@@ -261,6 +276,12 @@ class _MeshResidentProgram:
             # guarding the ppermute/diffusion logic on the jnp path; the
             # Pallas composition is pinned by the interpret-mode regression
             # (test_mesh_pallas_inside_shard_map) + the CPU parity suite.
+            # TRACKING (ADVICE r5): the disable covers the WHOLE body, so on
+            # TPU the checker also stops guarding the ppermute/diffusion
+            # logic — re-scope it to the pallas_call alone once jax lets
+            # pallas_call declare vma on out_shapes (jax#21577 direction);
+            # until then a collective-logic regression there is only caught
+            # by the jnp-path CPU tests.
             check_vma=not PK.use_pallas(mesh.devices.flat[0]),
         )
         self._step = jax.jit(mapped, donate_argnums=(0, 1))
@@ -339,7 +360,15 @@ class _MeshResidentProgram:
         return self._step(*state)
 
     def read_stats(self, out):
-        *state, tree, sol, cycles = out
+        """(state, tree, sol, cycles, sizes, best, tree_vec, ctr) — ``ctr``
+        is the harvested (D, NSLOTS) counter block when device counters are
+        on, else None (same dispatch-boundary readback as the scalars)."""
+        if self.inner.obs:
+            *state, tree, sol, cycles, ctr = out
+            ctr = np.asarray(ctr)
+        else:
+            *state, tree, sol, cycles = out
+            ctr = None
         sizes = np.asarray(state[2])
         best = int(np.asarray(state[3]).min())
         return (
@@ -350,6 +379,7 @@ class _MeshResidentProgram:
             sizes,
             best,
             np.asarray(tree),
+            ctr,
         )
 
     def residual_batch(self, state) -> dict:
@@ -456,6 +486,7 @@ def mesh_resident_search(
         tree1, sol1, best = warmup(problem, pool, best, target)
     t1 = time.perf_counter()
     phases.append(PhaseStats(t1 - t0, tree1, sol1))
+    ev.counter("explored", tree=tree1, sol=sol1, phase=1)
 
     # -- phase 2: SPMD resident loop ---------------------------------------
     # Cache the compiled SPMD program on the problem (recompiling the
@@ -472,6 +503,7 @@ def mesh_resident_search(
         tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
         m, M, K, rounds, T, capacity,
         routing_cache_token(problem, mesh.devices.flat[0]),
+        obs_counters.device_counters_enabled(),
     )
     program = cache.get(key)
     if program is None:
@@ -509,19 +541,45 @@ def mesh_resident_search(
         program._step, "mesh-resident step", enabled=guard_enabled(guard)
     )
 
+    ctr_total: dict | None = None
+    fb_tree = fb_sol = 0  # saturation-fallback host increments (obs parity)
+    prev_best = best
+
+    def obs_result() -> dict | None:
+        return (
+            {"device_counters": ctr_total} if ctr_total is not None else None
+        )
+
     while True:
+        t_disp = ev.now_us()
         with sguard.step():
             out = program.step(state)
-        state, ti, si, cy, sizes, best, tree_vec = program.read_stats(out)
+        state, ti, si, cy, sizes, best, tree_vec, ctr = \
+            program.read_stats(out)
         tree2 += ti
         sol2 += si
         per_worker += tree_vec.astype(np.int64)
         diagnostics.kernel_launches += cy
+        if ctr is not None:
+            ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        if ev.enabled():
+            ev.complete("dispatch", t_disp, args={
+                "cycles": cy, "tree": ti, "sol": si,
+                "size": int(sizes.sum()), "best": best,
+                "shard_sizes": sizes.tolist(),
+            })
+            if ctr is not None:
+                ev.counter("device_counters", **obs_counters.as_args(ctr))
+            if best < prev_best:
+                ev.emit("incumbent", args={"best": best})
+        prev_best = best
         if int(sizes.max()) < m:
             break
         if controller.after_step(tree1 + tree2, sol1 + sol2):
             t2 = time.perf_counter()
             phases.append(PhaseStats(t2 - t1, tree2, sol2))
+            ev.emit("checkpoint", args={"cutoff": True})
+            _emit_device_explored(ctr_total, tree2, sol2, fb_tree, fb_sol)
             return SearchResult(
                 explored_tree=tree1 + tree2,
                 explored_sol=sol1 + sol2,
@@ -531,6 +589,7 @@ def mesh_resident_search(
                 diagnostics=diagnostics,
                 per_worker_tree=per_worker.tolist(),
                 complete=False,
+                obs=obs_result(),
             )
         if cy == 0 and prev_sizes is not None and np.array_equal(sizes, prev_sizes):
             # Saturation: no shard ran a cycle and balancing moved nothing.
@@ -538,6 +597,8 @@ def mesh_resident_search(
             # single-device tier) until the frontier fits again.
             from ..engine.device import DeviceOffloader, bucket_size
 
+            t_fb = ev.now_us()
+            fb_tree0, fb_sol0 = tree2, sol2
             pool.reset_from(program.full_batch(state))
             diagnostics.device_to_host += 1
             if offloader is None:
@@ -567,6 +628,11 @@ def mesh_resident_search(
             diagnostics.host_to_device += 1
             # Sanctioned re-upload; next dispatch is a fresh warm one.
             sguard.rearm()
+            fb_tree += tree2 - fb_tree0
+            fb_sol += sol2 - fb_sol0
+            ev.complete("overflow_fallback", t_fb, args={
+                "tree": tree2 - fb_tree0, "sol": sol2 - fb_sol0,
+            })
             prev_sizes = None
             continue
         prev_sizes = sizes
@@ -575,11 +641,13 @@ def mesh_resident_search(
     pool.reset_from(batch)
     t2 = time.perf_counter()
     phases.append(PhaseStats(t2 - t1, tree2, sol2))
+    _emit_device_explored(ctr_total, tree2, sol2, fb_tree, fb_sol)
 
     # -- phase 3: host drain ------------------------------------------------
     tree3, sol3, best = drain(problem, pool, best)
     t3 = time.perf_counter()
     phases.append(PhaseStats(t3 - t2, tree3, sol3))
+    ev.counter("explored", tree=tree3, sol=sol3, phase=3)
 
     return SearchResult(
         explored_tree=tree1 + tree2 + tree3,
@@ -589,4 +657,5 @@ def mesh_resident_search(
         phases=phases,
         diagnostics=diagnostics,
         per_worker_tree=per_worker.tolist(),
+        obs=obs_result(),
     )
