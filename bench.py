@@ -885,6 +885,10 @@ def main() -> int:
     # mode skips them all and shares the finalization tail below) ----------
     if not express:
         _collect_extras(extras, on_tpu, staged_ok, staged_err)
+    # Published-config rate rows run in BOTH modes (bounded — a few
+    # dispatches each), so any green window banks a first ta021/N16/N17
+    # number automatically.
+    _published_rate_rows(extras, on_tpu)
     if express:
         record["express"] = True
     record["backend"] = jax.default_backend()
@@ -899,6 +903,49 @@ def main() -> int:
         record_last_good(record)
     print(json.dumps(record))
     return 0 if record.get("parity") else 1
+
+
+def _published_rate_rows(extras: list, on_tpu: bool) -> None:
+    """First measured numbers for the published BASELINE configs 2 and 4
+    (N-Queens N=16/17 and ta021 lb2 — VERDICT r5 #5): their full searches
+    are minutes-to-hours at current rates, so these are BOUNDED-dispatch
+    rate rows — ``max_steps`` cuts after a few K-cycle dispatches and the
+    metric is device-phase nodes/s (golden-count parity is not computable
+    on a cutoff; ``complete`` records whether the run happened to finish).
+    On-TPU only: CPU smoke must not pay minutes for rate rows that mean
+    nothing off-chip. One warm dispatch per config compiles via the
+    persistent cache (scripts/warm_cache.py banks the same shapes)."""
+    if not on_tpu:
+        return
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+
+    configs = [
+        ("pfsp_ta021_lb2_nodes_per_sec_per_chip_bounded",
+         lambda: PFSPProblem(inst=21, lb="lb2", ub=1), 1024, 4),
+        ("nqueens_n16_nodes_per_sec_per_chip_bounded",
+         lambda: NQueensProblem(N=16), 65536, 4),
+        ("nqueens_n17_nodes_per_sec_per_chip_bounded",
+         lambda: NQueensProblem(N=17), 65536, 4),
+    ]
+    for metric, mk, M, steps in configs:
+        try:
+            resident_search(mk(), m=25, M=M, max_steps=1)  # compile + warm
+            res = resident_search(mk(), m=25, M=M, max_steps=steps)
+            device_phase = (res.phases[1].seconds if len(res.phases) > 1
+                            else res.elapsed)
+            extras.append({
+                "metric": metric,
+                "value": round(res.explored_tree / max(device_phase, 1e-9), 1),
+                "unit": "nodes/sec",
+                "bounded_steps": steps,
+                "explored_tree": res.explored_tree,
+                "complete": res.complete,
+            })
+        except Exception as e:  # noqa: BLE001 — rate rows never fail a bench
+            extras.append({
+                "metric": metric, "error": f"{type(e).__name__}: {e}",
+            })
 
 
 def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,

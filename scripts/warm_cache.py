@@ -75,11 +75,30 @@ CONFIGS: list[tuple[str, list[str], dict[str, str]]] = [
      {"TTS_LB2_STAGED": "1"}),
     ("ta014 lb2 unstaged M=1024", ["pfsp", "14", "lb2", "-", "1024"],
      {"TTS_LB2_STAGED": "0"}),
+    # Pair-block A/B for the armed lb2 session (docs/HW_VALIDATION.md):
+    # the serial-loop build (TTS_LB2_PAIRBLOCK=1) is a distinct program
+    # from the default blocked one warmed above — bank both so the A/B
+    # costs measurement time only.
+    ("ta014 lb2 staged M=1024 pairblock=1", ["pfsp", "14", "lb2", "-", "1024"],
+     {"TTS_LB2_STAGED": "1", "TTS_LB2_PAIRBLOCK": "1"}),
+    # Published BASELINE config 4 (ta021-ta030 class, 20x20, P=190 —
+    # `pfsp_multigpu_chpl.chpl:312`): never benched on chip; warm both
+    # staged variants at the lb2-tuned chunk size so the first measured
+    # ta021 number pays zero compile seconds.
+    ("ta021 lb2 staged M=1024", ["pfsp", "21", "lb2", "-", "1024"],
+     {"TTS_LB2_STAGED": "1"}),
+    ("ta021 lb2 unstaged M=1024", ["pfsp", "21", "lb2", "-", "1024"],
+     {"TTS_LB2_STAGED": "0"}),
     ("ta014 lb1 M=1024 jnp", ["pfsp", "14", "lb1", "-", "1024"],
      {"TTS_PALLAS": "0"}),
     ("ta014 lb1 M=1024", ["pfsp", "14", "lb1", "-", "1024"], {}),
     ("ta014 lb1_d M=1024", ["pfsp", "14", "lb1_d", "-", "1024"], {}),
     ("nqueens N=15 M=65536", ["nqueens", "15", "65536"], {}),
+    # Published BASELINE config 2 (N-Queens N=16/17): the bench's bounded
+    # rate rows dispatch these exact programs (max_steps cuts the run, the
+    # compile is shape-identical).
+    ("nqueens N=16 M=65536", ["nqueens", "16", "65536"], {}),
+    ("nqueens N=17 M=65536", ["nqueens", "17", "65536"], {}),
     # Compaction-mode variants (ADVICE r5): bench's on-TPU A/B also
     # dispatches TTS_COMPACT=sort and =search builds of the headline and
     # lb2 programs (compact_mode is part of the routing token, so each is

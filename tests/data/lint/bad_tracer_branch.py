@@ -28,3 +28,14 @@ def kernel(x, flag):
 
 
 wrapped = jax.jit(kernel)
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("k",))
+def rebound(x, k: int):
+    k = x.sum()  # rebind: the static name now carries a traced value
+    if k > 0:  # BAD: branch on the re-tainted name
+        return x
+    return x * 2

@@ -47,3 +47,27 @@ def shapes(x):
 
 
 shaped = jax.jit(shapes)
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("block",))
+def blocked(x, block: int = 1):
+    # static_argnames params are Python ints at trace time: branching on
+    # them specializes the compiled program (the pair-block pattern).
+    if block > 1:
+        for b in range(x.shape[0] // block):
+            x = x + b
+        return x
+    acc = block * 2
+    while acc < 8:  # static: derived from the static param only
+        acc *= 2
+    return x * acc
+
+
+@partial(jax.jit, static_argnums=(1,))
+def blocked_by_num(x, block):
+    if block > 1:  # static via static_argnums -> positional name
+        return x * block
+    return x

@@ -87,6 +87,23 @@ def test_all_tiers_match_sequential_staged_lb2(seed, monkeypatch):
     _fuzz_all_tiers(seed, "lb2")
 
 
+@pytest.mark.parametrize("seed,pairblock,staged", [
+    (101, "1", "0"),   # serial pair loop (degenerate old behavior)
+    (101, "4", "0"),   # multi-block at these P (machines 3-5 -> P 3-10)
+    (101, "4", "1"),   # blocked self bound through the staged evaluator
+    (131, "auto", "1"),  # the default policy end to end
+])
+def test_all_tiers_match_sequential_pairblocked_lb2(seed, pairblock, staged,
+                                                    monkeypatch):
+    """Fuzz axis over the lb2 pair-block size: every tier — including the
+    dp x mp mesh, where each shard blocks its own P/mp pair subset — must
+    land the sequential counts under every block size, serial through
+    auto, staged and unstaged."""
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", pairblock)
+    monkeypatch.setenv("TTS_LB2_STAGED", staged)
+    _fuzz_all_tiers(seed, "lb2")
+
+
 def _random_instance(seed: int, jobs: int, machines: int):
     rng = np.random.default_rng(seed)
     return np.ascontiguousarray(

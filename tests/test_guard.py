@@ -119,9 +119,6 @@ def test_resident_guard_env_knob(monkeypatch):
 def test_mesh_resident_guard():
     import jax
 
-    if not hasattr(jax, "shard_map"):
-        pytest.skip("mesh tier needs jax.shard_map (not in this jax build; "
-                    "the whole mesh tier skips/fails on it in the seed)")
     if len(jax.devices()) < 2:
         pytest.skip("needs the virtual multi-device CPU platform")
     from tpu_tree_search.parallel.resident_mesh import mesh_resident_search

@@ -60,7 +60,9 @@ def test_host_sync_bad_fixture():
 def test_tracer_branch_bad_fixture():
     fs = findings_of(FIXTURES / "bad_tracer_branch.py", "tracer-branch")
     lines = sorted(f.line for f in fs)
-    assert lines == [9, 12, 23]
+    # line 39: a static_argnames param REBOUND from a traced value is
+    # re-tainted — the static exemption is per-name seed, not a blanket.
+    assert lines == [9, 12, 23, 39]
     # the static-shape `if` (line 15) and the `is None` check (line 26)
     # must NOT be flagged
     assert 15 not in lines and 26 not in lines
@@ -128,17 +130,17 @@ def test_waiver_without_reason_is_a_finding(tmp_path):
 def test_baseline_ratchet(tmp_path):
     bad = FIXTURES / "bad_tracer_branch.py"
     res = lint([str(bad)])
-    assert len(res["new"]) == 3
+    assert len(res["new"]) == 4
     bl = tmp_path / "bl.json"
     save_baseline(str(bl), res["new"])
     counts = load_baseline(str(bl))
     res2 = lint([str(bad)], counts)
-    assert res2["new"] == [] and len(res2["baselined"]) == 3
+    assert res2["new"] == [] and len(res2["baselined"]) == 4
     # shrinking the accepted count resurfaces the whole cell
     cell = next(iter(counts))
     counts[cell] -= 1
     new, old = ratchet(res["new"], counts)
-    assert len(new) == 3 and old == []
+    assert len(new) == 4 and old == []
 
 
 def test_repo_lints_clean_with_committed_baseline():

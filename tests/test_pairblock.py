@@ -1,0 +1,315 @@
+"""Pair-blocked lb2 (TTS_LB2_PAIRBLOCK) and the lb2 variant enum.
+
+The Johnson machine-pair axis is evaluated in blocks of ``Pb`` pairs as an
+extra tensor axis (`ops/pfsp_device._lb2_chunk` / `_lb2_self_chunk`) instead
+of the reference's serial per-pair loop (`Bound_johnson.chpl:188-239`).
+Blocking must be bit-exact against the serial path and the numpy oracle for
+every block size — including the degenerate ``Pb=1`` (old behavior) and
+``Pb=P`` — at ta014-class (P=45) and ta021-class (20x20, P=190) shapes,
+across jnp and Pallas-interpret, under every lb2 variant; the blocked
+compiled program must contain no per-pair serial loop; and the resolved
+block size must key the program caches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.engine.sequential import sequential_search
+from tpu_tree_search.ops import pallas_kernels as PK
+from tpu_tree_search.ops import pfsp_device as P
+from tpu_tree_search.problems import PFSPProblem
+from tpu_tree_search.problems.pfsp import bounds as B
+from tpu_tree_search.problems.pfsp import taillard
+
+
+def _random_nodes(rng, jobs, count, min_limit1=-1):
+    prmu = np.stack([rng.permutation(jobs).astype(np.int32)
+                     for _ in range(count)])
+    limit1 = rng.integers(min_limit1, jobs - 1, count).astype(np.int32)
+    return prmu, limit1
+
+
+def _tables(prob):
+    return P.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+
+
+# ta014 class (20x10, P=45) and ta021 class (20x20, P=190 — the published
+# lb2 target config, `pfsp_multigpu_chpl.chpl:312`).
+SHAPES = [pytest.param(14, id="ta014-P45"), pytest.param(21, id="ta021-P190")]
+
+
+@pytest.mark.parametrize("inst", SHAPES)
+@pytest.mark.parametrize("variant", ["full", "nabeshima", "lageweg"])
+def test_lb2_chunk_pairblock_bit_exact(inst, variant):
+    """Blocked child bounds == serial child bounds == numpy oracle, for
+    Pb in {1, 8, P} (and a non-divisor to exercise block padding)."""
+    rng = np.random.default_rng(7 + inst)
+    prob = PFSPProblem(inst=inst, lb="lb2", ub=1, lb2_variant=variant)
+    t = _tables(prob)
+    n, Pn = prob.jobs, t.pairs.shape[0]
+    Bsz = 32
+    prmu, limit1 = _random_nodes(rng, n, Bsz)
+    pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+    serial = np.asarray(P._lb2_chunk(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules, pairblock=1,
+    ))
+    open_ = np.arange(n)[None, :] >= limit1[:, None] + 1
+    for pb in {1, 7, 8, Pn, Pn + 5}:
+        got = np.asarray(P._lb2_chunk(
+            pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+            t.pairs, t.lags, t.johnson_schedules, pairblock=pb,
+        ))
+        assert np.array_equal(serial[open_], got[open_]), (variant, pb)
+    # Numpy oracle on a few children (full bound, no early exit).
+    big = 10**9
+    for i in range(4):
+        li = int(limit1[i])
+        for k in range(li + 1, n):
+            child = prmu[i].copy()
+            child[li + 1], child[k] = child[k], child[li + 1]
+            want = B.lb2_bound(prob.lb1_data, prob.lb2_data, child,
+                               li + 1, n, big)
+            assert serial[i, k] == want, (variant, i, k)
+
+
+@pytest.mark.parametrize("inst", SHAPES)
+def test_lb2_self_chunk_pairblock_bit_exact(inst):
+    rng = np.random.default_rng(11 + inst)
+    prob = PFSPProblem(inst=inst, lb="lb2", ub=1)
+    t = _tables(prob)
+    n, Pn = prob.jobs, t.pairs.shape[0]
+    prmu, limit1 = _random_nodes(rng, n, 32, min_limit1=0)
+    pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+    serial = np.asarray(P._lb2_self_chunk(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules, pairblock=1,
+    ))
+    for pb in {8, Pn}:
+        got = np.asarray(P._lb2_self_chunk(
+            pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+            t.pairs, t.lags, t.johnson_schedules, pairblock=pb,
+        ))
+        assert np.array_equal(serial, got), pb
+    # Oracle: self bound of a row == lb2_bound of the node itself.
+    big = 10**9
+    for i in range(6):
+        want = B.lb2_bound(prob.lb1_data, prob.lb2_data, prmu[i],
+                           int(limit1[i]), n, big)
+        assert serial[i] == want, i
+
+
+@pytest.mark.parametrize("pg", [1, 4, 8])
+def test_pallas_kernels_pair_group_parity_at_P190(pg):
+    """Pallas child + staged-self kernels with pair-group unrolling, at the
+    published ta021 shape (P=190 — pg divides and doesn't divide it),
+    interpret mode, vs the jnp oracles."""
+    rng = np.random.default_rng(23)
+    prob = PFSPProblem(inst=21, lb="lb2", ub=1)
+    t = _tables(prob)
+    n = prob.jobs
+    prmu, limit1 = _random_nodes(rng, n, 32)
+    pd, ld = jnp.asarray(prmu), jnp.asarray(limit1)
+    oracle = np.asarray(P._lb2_chunk(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    ))
+    got = np.asarray(PK.pfsp_lb2_bounds(pd, ld, t, interpret=True,
+                                        pair_group=pg))
+    open_ = np.arange(n)[None, :] >= limit1[:, None] + 1
+    assert np.array_equal(oracle[open_], got[open_])
+    # Self kernel on rows with limit1 >= 0 (staged contract).
+    prmu2, limit2 = _random_nodes(rng, n, 24, min_limit1=0)
+    p2, l2 = jnp.asarray(prmu2), jnp.asarray(limit2)
+    self_oracle = np.asarray(P._lb2_self_chunk(
+        p2, l2, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    ))
+    self_got = np.asarray(PK.pfsp_lb2_self_bounds(
+        p2, l2, 24, t, interpret=True, pair_group=pg,
+    ))
+    assert np.array_equal(self_oracle, self_got)
+
+
+def _count_loop_ops(closed_jaxpr) -> int:
+    """Serial device loops in a jaxpr: fori_loop lowers to `scan` when the
+    trip count is static and `while` otherwise — count both, recursively
+    through pjit/cond/scan sub-jaxprs."""
+    def subjaxprs(v):
+        if hasattr(v, "jaxpr"):  # ClosedJaxpr
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if hasattr(x, "jaxpr"):
+                    yield x.jaxpr
+
+    def walk(jaxpr) -> int:
+        total = 0
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in ("while", "scan"):
+                total += 1
+            for v in eqn.params.values():
+                for sub in subjaxprs(v):
+                    total += walk(sub)
+        return total
+    return walk(closed_jaxpr.jaxpr)
+
+
+def test_blocked_jaxpr_has_no_per_pair_loop():
+    """The pinned structural claim: with blocking on, the compiled lb2
+    child/self evaluators contain NO fori_loop whose trip count scales
+    with P — the only while op left is `_parent_state`'s O(n) prefix scan.
+    The serial build (Pb=1) keeps its pair loop (2 while ops), so the
+    count isn't trivially zero-by-construction."""
+    prob = PFSPProblem(inst=21, lb="lb2", ub=1)
+    t = _tables(prob)
+    n = prob.jobs
+    args = (jnp.zeros((8, n), jnp.int32), jnp.zeros((8,), jnp.int32),
+            t.ptm_t, t.min_heads, t.min_tails, t.pairs, t.lags,
+            t.johnson_schedules)
+
+    def child(pb):
+        return jax.make_jaxpr(
+            lambda *a: P._lb2_chunk(*a, pairblock=pb))(*args)
+
+    def self_(pb):
+        return jax.make_jaxpr(
+            lambda *a: P._lb2_self_chunk(*a, pairblock=pb))(*args)
+
+    pb_auto = P.lb2_pairblock(t.pairs.shape[0], n)
+    assert pb_auto > 1  # default policy actually blocks at ta021
+    assert _count_loop_ops(child(1)) == 2  # n-scan + serial pair loop
+    assert _count_loop_ops(child(pb_auto)) == 1  # n-scan only
+    assert _count_loop_ops(self_(1)) == 2
+    assert _count_loop_ops(self_(pb_auto)) == 1
+
+
+def test_pairblock_keys_routing_token_and_rebuilds_program(monkeypatch):
+    """Flipping TTS_LB2_PAIRBLOCK between searches on ONE problem instance
+    must change `routing_cache_token` and rebuild the resident program —
+    the block size is baked in at trace time — and both builds must land
+    the same exact counts."""
+    ptm = taillard.reduced_instance(14, jobs=8, machines=5)
+    prob = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    opt = sequential_search(PFSPProblem(lb="lb2", ub=0, p_times=ptm)).best
+
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "1")
+    tok1 = P.routing_cache_token(prob)
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "4")
+    tok4 = P.routing_cache_token(prob)
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "auto")
+    tok_auto = P.routing_cache_token(prob)  # resolves to P=10 here
+    assert len({tok1, tok4, tok_auto}) == 3
+
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "1")
+    r1 = resident_search(prob, m=8, M=128, K=8, initial_best=opt)
+    n_first = len(prob._resident_programs)
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "4")
+    r2 = resident_search(prob, m=8, M=128, K=8, initial_best=opt)
+    assert len(prob._resident_programs) == n_first + 1, (
+        "pairblock flip reused the stale program"
+    )
+    assert (r1.explored_tree, r1.explored_sol, r1.best) == (
+        r2.explored_tree, r2.explored_sol, r2.best
+    )
+
+
+def test_pairblock_knob_validation(monkeypatch):
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "0")
+    with pytest.raises(ValueError, match="must be >= 1"):
+        P.lb2_pairblock(45, 20)
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "fast")
+    with pytest.raises(ValueError, match="'auto' or a positive integer"):
+        P.lb2_pairblock(45, 20)
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "512")
+    assert P.lb2_pairblock(45, 20) == 45  # clamped to P
+    monkeypatch.delenv("TTS_LB2_PAIRBLOCK", raising=False)
+    assert P.lb2_pairblock(45, 20) == 45   # auto: single block at ta014
+    assert P.lb2_pairblock(190, 20) == 64  # auto: 3 blocks at ta021
+    assert P.lb2_pairblock(190, 500) == 4  # auto shrinks with job count
+    assert P.lb2_kernel_pair_group(190, 20) == 8  # kernel unroll cap
+
+
+# -- lb2 variant enum (`Bound_johnson.chpl:50-88`) --------------------------
+
+
+def test_variant_pair_sets_hand_checked():
+    """`fill_machine_pairs` equivalents at m=4, against hand-written sets."""
+    assert B.machine_pairs(4, "full") == [
+        (0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3)
+    ]
+    assert B.machine_pairs(4, "nabeshima") == [(0, 1), (1, 2), (2, 3)]
+    assert B.machine_pairs(4, "lageweg") == [(0, 3), (1, 3), (2, 3)]
+    # Counts at the published 20-machine shape.
+    assert len(B.machine_pairs(20, "full")) == 190
+    assert len(B.machine_pairs(20, "nabeshima")) == 19
+    assert len(B.machine_pairs(20, "lageweg")) == 19
+    with pytest.raises(ValueError, match="lb2_variant"):
+        B.machine_pairs(4, "learn")
+
+
+@pytest.mark.parametrize("variant", ["nabeshima", "lageweg"])
+def test_variant_bounds_are_valid_and_dominated_by_full(variant):
+    """A pair-subset bound is (a) a valid lower bound on every completion
+    and (b) pointwise <= the full-variant bound (max over a subset)."""
+    ptm = taillard.reduced_instance(21, jobs=8, machines=6)
+    d1 = B.make_lb1(ptm)
+    d2_full = B.make_lb2(d1, "full")
+    d2_sub = B.make_lb2(d1, variant)
+    rng = np.random.default_rng(17)
+    big = 10**9
+    for _ in range(25):
+        prmu = rng.permutation(8).astype(np.int32)
+        limit1 = int(rng.integers(-1, 7))
+        sub = B.lb2_bound(d1, d2_sub, prmu, limit1, 8, big)
+        full = B.lb2_bound(d1, d2_full, prmu, limit1, 8, big)
+        assert sub <= full
+        for _ in range(4):
+            tail = prmu[limit1 + 1:].copy()
+            rng.shuffle(tail)
+            whole = np.concatenate([prmu[: limit1 + 1], tail])
+            assert B.eval_solution(d1, whole) >= sub
+
+
+@pytest.mark.parametrize("variant", ["nabeshima", "lageweg"])
+def test_variant_cross_tier_parity_and_pairblock_compose(variant,
+                                                         monkeypatch):
+    """Each variant explores the identical tree on seq vs resident, with
+    pair-blocking clamped to the smaller pair set (P = m-1 < Pb just means
+    one block)."""
+    ptm = taillard.reduced_instance(3, jobs=7, machines=5)
+
+    def mk():
+        return PFSPProblem(lb="lb2", ub=0, p_times=ptm, lb2_variant=variant)
+
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", "8")  # > P=4: clamps to one block
+    res = resident_search(mk(), m=4, M=64, K=8, initial_best=opt)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    assert res.best == opt
+
+
+def test_variant_checkpoint_identity(tmp_path):
+    """A non-full variant prunes a different tree: its checkpoints must
+    refuse to resume under another variant (and vice versa)."""
+    from tpu_tree_search.engine import checkpoint as ckpt
+
+    ptm = taillard.reduced_instance(5, jobs=7, machines=4)
+    full = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    nab = PFSPProblem(lb="lb2", ub=0, p_times=ptm, lb2_variant="nabeshima")
+    assert ckpt.problem_meta(full) != ckpt.problem_meta(nab)
+    path = str(tmp_path / "v.ckpt")
+    batch = {k: v for k, v in nab.root().items()}
+    ckpt.save(path, nab, batch, best=10**9, tree=0, sol=0)
+    with pytest.raises(ValueError, match="checkpoint is for"):
+        ckpt.load(path, full)
+    loaded = ckpt.load(path, nab)
+    assert loaded.meta["lb2_variant"] == "nabeshima"

@@ -374,7 +374,9 @@ def test_lb2_staged_mp_matches_full_inside_shard_map():
             pd, ld, cd, t, mp_axis="mp", mp_size=2
         )[None]
 
-    got = np.asarray(jax.jit(jax.shard_map(
+    from tpu_tree_search.utils import jax_compat
+
+    got = np.asarray(jax.jit(jax_compat.shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(), P()), out_specs=P("mp"),
     ))(pd, ld, jnp.asarray(cand)))

@@ -200,9 +200,36 @@ def _target_names(target: ast.AST) -> set[str]:
     }
 
 
-def tainted_names(fn: ast.AST) -> set[str]:
+def jit_static_params(module: Module, fn: ast.AST) -> set[str]:
+    """Parameter names the function's own jit decorator declares static
+    (``static_argnames``, plus ``static_argnums`` mapped through the
+    positional list): Python values at trace time, never tracers, so they
+    must not seed the taint set — branching on them is how a static knob
+    (e.g. ``pairblock``) legitimately specializes the compiled program."""
+    if isinstance(fn, ast.Lambda):
+        return set()
+    nums: set[int] = set()
+    names: set[str] = set()
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call) and (
+            _is_trace_entry(module, dec) or _partial_trace_entry(module, dec)
+        ):
+            n2, s2 = _jit_static_sets(dec)
+            nums |= n2
+            names |= s2
+    args = fn.args.posonlyargs + fn.args.args
+    for i in nums:
+        if 0 <= i < len(args):
+            names.add(args[i].arg)
+    return names
+
+
+def tainted_names(fn: ast.AST, static: set[str] = frozenset()) -> set[str]:
     """Forward may-analysis: parameters are traced values; anything assigned
-    from an expression mentioning a traced name may be traced too."""
+    from an expression mentioning a traced name may be traced too.
+    ``static`` names (a jit decorator's static params) are excluded up
+    front — they are Python values under the trace — though an in-body
+    rebind from a tainted expression re-taints them."""
     if isinstance(fn, ast.Lambda):
         args = fn.args
     else:
@@ -214,6 +241,7 @@ def tainted_names(fn: ast.AST) -> set[str]:
         taint.add(args.vararg.arg)
     if args.kwarg:
         taint.add(args.kwarg.arg)
+    taint -= set(static)
     if isinstance(fn, ast.Lambda):
         return taint
     for _ in range(10):  # fixpoint (bounded; assignments chains are short)
@@ -277,7 +305,7 @@ def host_sync_in_jit(module: Module, project: Project) -> list[Finding]:
     vs ~0.5 ms per on-device cycle)."""
     findings: list[Finding] = []
     for fn in traced_functions(module, project):
-        taint = tainted_names(fn)
+        taint = tainted_names(fn, jit_static_params(module, fn))
         for node in _own_nodes(fn):
             if not isinstance(node, ast.Call):
                 continue
@@ -327,7 +355,7 @@ def tracer_branch(module: Module, project: Project) -> list[Finding]:
     concrete sizes, silently bakes one branch into the compiled program."""
     findings: list[Finding] = []
     for fn in traced_functions(module, project):
-        taint = tainted_names(fn)
+        taint = tainted_names(fn, jit_static_params(module, fn))
         if isinstance(fn, ast.Lambda):
             continue
         for node in _own_nodes(fn):

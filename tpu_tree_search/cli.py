@@ -145,6 +145,19 @@ def build_parser() -> argparse.ArgumentParser:
     pf.add_argument("--lb", type=str, default="lb1", choices=["lb1", "lb1_d", "lb2"])
     pf.add_argument("--ub", type=int, default=1, choices=[0, 1],
                     help="initial upper bound: 1=known optimum, 0=inf")
+    pf.add_argument("--lb2-variant", type=str, default="full",
+                    choices=["full", "nabeshima", "lageweg"],
+                    help="lb2 Johnson machine-pair subset (the reference's "
+                    "enum lb2_variant, Bound_johnson.chpl:6): full = all "
+                    "m(m-1)/2 pairs (reference default); nabeshima = "
+                    "adjacent pairs (i, i+1); lageweg = every machine "
+                    "paired with the last — both m-1 pairs, weaker bounds "
+                    "but ~m/2x fewer pair evaluations")
+    pf.add_argument("--lb2-pairblock", type=str, default=None,
+                    help="lb2 machine-pair block size: evaluate this many "
+                    "Johnson pairs at once as an extra tensor axis "
+                    "(default: TTS_LB2_PAIRBLOCK env or 'auto'; 1 = the "
+                    "serial per-pair loop; clamped to the pair count)")
 
     lint = sub.add_parser(
         "lint",
@@ -233,6 +246,24 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
         if args.problem != "pfsp" or args.lb != "lb2":
             parser.error("--mp shards the lb2 Johnson pair loop "
                          "(pfsp --lb lb2 only)")
+    if args.problem == "pfsp":
+        if args.lb2_variant != "full" and args.lb != "lb2":
+            parser.error("--lb2-variant selects the lb2 Johnson pair "
+                         "subset (--lb lb2 only)")
+        if args.lb2_pairblock is not None:
+            if args.lb != "lb2":
+                parser.error("--lb2-pairblock batches the lb2 Johnson "
+                             "pair axis (--lb lb2 only)")
+            if args.lb2_pairblock != "auto":
+                try:
+                    v = int(args.lb2_pairblock)
+                except ValueError:
+                    parser.error("--lb2-pairblock must be 'auto' or a "
+                                 "positive integer")
+                else:
+                    if v < 1:
+                        parser.error("--lb2-pairblock must be >= 1 "
+                                     "(1 = the serial per-pair loop)")
 
 
 def make_problem(args):
@@ -242,7 +273,8 @@ def make_problem(args):
         return NQueensProblem(N=args.N, g=args.g)
     from .problems import PFSPProblem
 
-    return PFSPProblem(inst=args.inst, lb=args.lb, ub=args.ub)
+    return PFSPProblem(inst=args.inst, lb=args.lb, ub=args.ub,
+                       lb2_variant=args.lb2_variant)
 
 
 def resolve_chunk_size(M, problem_name: str, tier: str, engine: str,
@@ -299,6 +331,8 @@ def run_tier(problem, args):
     pins = {}
     if args.compact is not None:
         pins["TTS_COMPACT"] = args.compact
+    if getattr(args, "lb2_pairblock", None) is not None:
+        pins["TTS_LB2_PAIRBLOCK"] = args.lb2_pairblock
     if args.guard:
         pins["TTS_GUARD"] = "1"
     if (
@@ -427,6 +461,8 @@ def print_settings(args) -> None:
         )
         print("Initial upper bound: " + ("opt" if args.ub == 1 else "inf"))
         print(f"Lower bound function: {args.lb}")
+        if args.lb == "lb2" and args.lb2_variant != "full":
+            print(f"lb2 machine-pair subset: {args.lb2_variant}")
         print("Branching rule: fwd")
     print("=================================================")
 
@@ -524,11 +560,27 @@ def result_record(args, res) -> dict:
             # bound shards its pair loop with a pmax combine. The job count
             # matters: auto mode only stages at n <= 100.
             from .ops import pfsp_device as P
+            from .problems.pfsp import bounds as PB
             from .problems.pfsp import taillard
 
-            rec["lb2_staged"] = P.lb2_staged_enabled(
-                None, taillard.nb_jobs(args.inst)
-            )
+            n_ = taillard.nb_jobs(args.inst)
+            rec["lb2_staged"] = P.lb2_staged_enabled(None, n_)
+            # Resolved pair-block size (the run's baked-in value): flag
+            # first — run_tier restores the env pin before this record is
+            # built (same convention as "compact" above).
+            Pn = len(PB.machine_pairs(
+                taillard.nb_machines(args.inst), args.lb2_variant
+            ))
+            knob = args.lb2_pairblock
+            if knob is None or knob == "auto":
+                rec["lb2_pairblock"] = (
+                    P._auto_pairblock(Pn, n_) if knob == "auto"
+                    else P.lb2_pairblock(Pn, n_)
+                )
+            else:
+                rec["lb2_pairblock"] = min(int(knob), Pn)
+            if args.lb2_variant != "full":
+                rec["lb2_variant"] = args.lb2_variant
     return rec
 
 

@@ -128,6 +128,12 @@ def problem_meta(problem: Problem) -> dict:
         meta.update(inst=getattr(problem, "inst", None), lb=problem.lb,
                     ub=problem.ub, jobs=problem.jobs, machines=problem.machines,
                     ptimes_sha=digest)
+        # Johnson pair subset (bounds.LB2_VARIANTS): a non-full variant
+        # prunes a different tree, so its frontier must not resume a full
+        # run's (and vice versa). Stamped only when non-default, so every
+        # pre-variant checkpoint keeps loading against full-variant runs.
+        if getattr(problem, "lb2_variant", "full") != "full":
+            meta.update(lb2_variant=problem.lb2_variant)
     return meta
 
 

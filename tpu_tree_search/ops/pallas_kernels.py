@@ -79,7 +79,11 @@ def _vmem_limit_bytes() -> int | None:
 
 
 def _compiler_params():
-    return pltpu.CompilerParams(
+    # CompilerParams was TPUCompilerParams before jax 0.5 (jax_compat-class
+    # rename, handled inline — this module must stay importable without
+    # touching the utils layer).
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(
         dimension_semantics=("arbitrary",), vmem_limit_bytes=_vmem_limit_bytes()
     )
 
@@ -100,14 +104,18 @@ def _r128(x: int) -> int:
 
 
 def _model_bytes(t: int, n: int, m: int, extra_bytes: int,
-                 tn2_copies: int) -> int:
+                 tn2_copies: int, pair_copies: int = 0,
+                 pair_group: int = 1) -> int:
     """The kernels' modeled VMEM footprint at batch tile ``t`` — the single
     source of truth shared by the tile chooser and the routing gate.
-    ``tn2_copies`` counts the (T, n, n)-class f32 live values (one-hot +
-    reshape copies for lb1; the pair loop's u_o/cum0/suf1 and their matmul
-    copies push lb2 higher); ``extra_bytes`` adds tile-independent
-    residents (lb2's per-pair tables)."""
-    tn2 = tn2_copies * t * _r8(n) * _r128(n) * 4
+    ``tn2_copies`` counts the shared (T, n, n)-class f32 live values
+    (one-hot + reshape copies); ``pair_copies`` the per-pair ones (the pair
+    body's u_o/mp0/mp1/cum0/suf1), charged once per member of the unrolled
+    pair group — the extra pair axis of the blocked lb2 kernels
+    (conservative: Mosaic may overlap the unrolled bodies' temporaries, so
+    the model assumes they are all live). ``extra_bytes`` adds
+    tile-independent residents (lb2's per-pair tables)."""
+    tn2 = (tn2_copies + pair_copies * pair_group) * t * _r8(n) * _r128(n) * 4
     oh_nt = n * _r8(t) * _r128(n) * 4
     scan = n * _r8(t) * _r128(m) * 4
     ptg = t * _r8(n) * _r128(m) * 4
@@ -120,7 +128,8 @@ def _vmem_budget() -> int:
 
 
 def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
-               tn2_copies: int = 3) -> int:
+               tn2_copies: int = 3, pair_copies: int = 0,
+               pair_group: int = 1) -> int:
     """Shrink the batch tile until the kernel's modeled VMEM footprint fits.
 
     The reference rebuilds with bigger compile-time params for large
@@ -131,7 +140,8 @@ def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
     halving the tile until it fits (floor 8)."""
     budget = _vmem_budget()
     tile = default
-    while tile > 8 and _model_bytes(tile, n, m, extra_bytes, tn2_copies) > budget:
+    while tile > 8 and _model_bytes(tile, n, m, extra_bytes, tn2_copies,
+                                    pair_copies, pair_group) > budget:
         # Halve, then align down to the sublane quantum (a non-power-of-two
         # env override must not walk below the floor or mis-align the
         # (tile, n) BlockSpec).
@@ -140,12 +150,15 @@ def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
 
 
 def _auto_tile_fits(n: int, m: int, default: int, extra_bytes: int = 0,
-                    tn2_copies: int = 3) -> bool:
+                    tn2_copies: int = 3, pair_copies: int = 0,
+                    pair_group: int = 1) -> bool:
     """True iff the kernel fits the VMEM model even at the smallest tile —
     the routing gate: shapes that do not fit must stay on the jnp path
     instead of dying inside a Mosaic VMEM OOM."""
-    tile = _auto_tile(n, m, default, extra_bytes, tn2_copies)
-    return _model_bytes(tile, n, m, extra_bytes, tn2_copies) <= _vmem_budget()
+    tile = _auto_tile(n, m, default, extra_bytes, tn2_copies, pair_copies,
+                      pair_group)
+    return _model_bytes(tile, n, m, extra_bytes, tn2_copies, pair_copies,
+                        pair_group) <= _vmem_budget()
 
 
 def _lb2_static_extra(n: int, m: int, P: int) -> int:
@@ -153,7 +166,8 @@ def _lb2_static_extra(n: int, m: int, P: int) -> int:
 
 
 # The single source of truth for each kernel's VMEM-model parameters:
-# (tile env knob, tile default, tn2_copies, needs per-pair extra).
+# (tile env knob, tile default, shared tn2_copies, needs per-pair extra,
+# per-pair tn2 copies — charged once per unrolled pair-group member).
 # Tile defaults: lb1 64 and lb1d 256 are MEASURED on the real v5e
 # (docs/HW_VALIDATION.md; lb1 at 128 compiled >270s — Mosaic compile time
 # grows superlinearly with tile). The lb2 family is not hardware-measured
@@ -163,34 +177,55 @@ def _lb2_static_extra(n: int, m: int, P: int) -> int:
 # out. scripts/tile_sweep.py re-measures per (kernel, tile) so the
 # defaults can be raised with data.
 _KERNEL_MODEL = {
-    "lb1": ("TTS_TILE_LB1", 64, 3, False),
-    "lb1d": ("TTS_TILE_LB1D", 256, 3, False),
-    "lb2": ("TTS_TILE_LB2", 64, 8, True),
-    "lb2self": ("TTS_TILE_LB2SELF", 64, 6, True),
+    "lb1": ("TTS_TILE_LB1", 64, 3, False, 0),
+    "lb1d": ("TTS_TILE_LB1D", 256, 3, False, 0),
+    "lb2": ("TTS_TILE_LB2", 64, 3, True, 5),
+    "lb2self": ("TTS_TILE_LB2SELF", 64, 1, True, 5),
 }
 
 
+def _resolve_pair_group(kernel: str, n: int, P: int | None,
+                        pair_group: int | None) -> int:
+    """The pair-group unroll a kernel will compile with: an explicit value
+    wins; otherwise the lb2-family kernels resolve the shared knob
+    (`pfsp_device.lb2_kernel_pair_group` — lazy import, both modules load
+    each other lazily so there is no cycle)."""
+    if pair_group is not None:
+        return pair_group
+    if kernel in ("lb2", "lb2self") and P is not None:
+        from . import pfsp_device
+
+        return pfsp_device.lb2_kernel_pair_group(P, n)
+    return 1
+
+
 def _kernel_tile_args(kernel: str, n: int, m: int, P: int | None):
-    env, default, copies, pairwise = _KERNEL_MODEL[kernel]
+    env, default, copies, pairwise, pair_copies = _KERNEL_MODEL[kernel]
     extra = _lb2_static_extra(n, m, P) if pairwise else 0
-    return _env_tile(env, default), extra, copies
+    return _env_tile(env, default), extra, copies, pair_copies
 
 
 def effective_tile(kernel: str, n: int, m: int, P: int | None = None,
-                   batch: int | None = None) -> int:
+                   batch: int | None = None,
+                   pair_group: int | None = None) -> int:
     """The batch tile a kernel will actually use for shape (n, m[, P]) —
     shared by the feasibility gates, the kernel callers, and
     scripts/tile_sweep.py so the model constants live in exactly one
     place."""
-    default, extra, copies = _kernel_tile_args(kernel, n, m, P)
-    tile = _auto_tile(n, m, default, extra_bytes=extra, tn2_copies=copies)
+    default, extra, copies, pair_copies = _kernel_tile_args(kernel, n, m, P)
+    pg = _resolve_pair_group(kernel, n, P, pair_group)
+    tile = _auto_tile(n, m, default, extra_bytes=extra, tn2_copies=copies,
+                      pair_copies=pair_copies, pair_group=pg)
     return tile if batch is None else min(tile, batch)
 
 
-def _kernel_feasible(kernel: str, n: int, m: int, P: int | None) -> bool:
-    default, extra, copies = _kernel_tile_args(kernel, n, m, P)
+def _kernel_feasible(kernel: str, n: int, m: int, P: int | None,
+                     pair_group: int | None = None) -> bool:
+    default, extra, copies, pair_copies = _kernel_tile_args(kernel, n, m, P)
+    pg = _resolve_pair_group(kernel, n, P, pair_group)
     return _auto_tile_fits(n, m, default, extra_bytes=extra,
-                           tn2_copies=copies)
+                           tn2_copies=copies, pair_copies=pair_copies,
+                           pair_group=pg)
 
 
 def lb1_kernel_feasible(n: int, m: int) -> bool:
@@ -475,7 +510,8 @@ def pfsp_lb1_d_bounds(
 def _lb2_kernel(
     prmu_ref, limit1_ref, ptm_ref, heads_ref,
     p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
-    out_ref, scan_ref, *, n: int, m: int, P: int, bf16: bool = False,
+    out_ref, scan_ref, *, n: int, m: int, P: int, pg: int = 1,
+    bf16: bool = False,
 ):
     """Full lb2 (two-machine Johnson) bound of every child in the tile.
 
@@ -485,6 +521,13 @@ def _lb2_kernel(
     whole pair loop runs against VMEM-resident tile state (child fronts,
     free-job flags, the Johnson-ordered tables), so the ~P x (B, n, n)
     intermediates never touch HBM.
+
+    ``pg``: pair-group unroll — the fori_loop runs over P/pg pair GROUPS
+    (caller pads P to a multiple) with pg statically-unrolled pair bodies
+    per iteration, giving the VPU/MXU pg independent chains to overlap
+    instead of one serialized pair per loop step (the pair-axis batching
+    of the blocked jnp path, expressed as unrolling here — the VMEM model
+    charges the per-pair live values once per group member).
     """
     prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
     limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
@@ -542,14 +585,24 @@ def _lb2_kernel(
         )
         return jnp.maximum(lb, pair_lb)
 
-    lb = jax.lax.fori_loop(0, P, pair_body, jnp.zeros((T, n), jnp.float32))
+    lb0 = jnp.zeros((T, n), jnp.float32)
+    if pg > 1:
+        def group_body(g, lb):
+            q0 = g * pg
+            for j in range(pg):  # static unroll within the group
+                lb = pair_body(q0 + j, lb)
+            return lb
+
+        lb = jax.lax.fori_loop(0, P // pg, group_body, lb0)
+    else:
+        lb = jax.lax.fori_loop(0, P, pair_body, lb0)
     out_ref[:] = lb.astype(jnp.int32)
 
 
 @lru_cache(maxsize=None)
 def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool,
-              bf16: bool = False):
-    kernel = partial(_lb2_kernel, n=n, m=m, P=P, bf16=bf16)
+              bf16: bool = False, pg: int = 1):
+    kernel = partial(_lb2_kernel, n=n, m=m, P=P, pg=pg, bf16=bf16)
     grid = (B // tile,)
     full = lambda i: (0, 0)
     full3 = lambda i: (0, 0, 0)
@@ -595,27 +648,34 @@ def _eager_context() -> bool:
 
 
 def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool | None = None,
-                    bf16: bool | None = None):
-    """(B, n) int32 lb2 child bounds; same contract as `_lb2_chunk`."""
+                    bf16: bool | None = None,
+                    pair_group: int | None = None):
+    """(B, n) int32 lb2 child bounds; same contract as `_lb2_chunk`.
+    ``pair_group``: pair-group unroll per grid step (None resolves the
+    shared TTS_LB2_PAIRBLOCK knob); the pair tables are padded to a
+    multiple of it with copies of pair 0 (max is idempotent)."""
     interpret = pallas_interpret() if interpret is None else interpret
     if bf16 is None:
         bf16 = getattr(tables, "exact_bf16", False)
     B, n = prmu.shape
     m = tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
-    # Tile-independent residents (per-pair tables) + ~8 (T, n, n)-class
-    # live f32 pair-loop values — see _KERNEL_MODEL["lb2"].
-    tile = effective_tile("lb2", n, m, P, batch=B)
+    pg = _resolve_pair_group("lb2", n, P, pair_group)
+    # Tile-independent residents (per-pair tables) + the shared + per-pair
+    # (T, n, n)-class live f32 pair-loop values — see _KERNEL_MODEL["lb2"].
+    tile = effective_tile("lb2", n, m, P, batch=B, pair_group=pg)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
         limit1 = jnp.pad(limit1, ((0, Bp - B),))
     # Eager calls reuse once-uploaded device tables; traced calls bake the
     # numpy tables as executable constants (and must NOT touch the device
-    # cache — building it under a trace would capture tracers).
-    ordered = (tables.johnson_ordered_device() if _eager_context()
-               else tables.johnson_ordered())
-    out = _lb2_call(n, m, P, Bp, tile, interpret, bf16)(
+    # cache — building it under a trace would capture tracers). Both are
+    # padded to a pair-group multiple (johnson_ordered_mp's policy).
+    ordered = (tables.johnson_ordered_device(pg) if _eager_context()
+               else tables.johnson_ordered_mp(pg))
+    Pp = ordered.lag_o.shape[0]
+    out = _lb2_call(n, m, Pp, Bp, tile, interpret, bf16, pg)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
         tables.ptm_t,
@@ -652,16 +712,17 @@ def pfsp_lb1_bounds(
 def _lb2_self_kernel(
     prmu_ref, limit1_ref, nact_ref, ptm_ref,
     p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
-    out_ref, scan_ref, *, n: int, m: int, P: int, tile: int,
+    out_ref, scan_ref, *, n: int, m: int, P: int, tile: int, pg: int = 1,
     bf16: bool = False,
 ):
     """Johnson bound of each ROW's own partial schedule (the staged
     evaluator's compacted child nodes) — `_lb2_kernel` with the
-    child-expansion axis dropped. Tiles whose rows are all beyond
-    ``n_active`` skip the entire body: this is where the incumbent-driven
-    work reduction lands (the reference's per-thread early exit,
-    `evaluate.cu:73-91`, becomes whole-tile skipping on the sequential
-    TPU grid)."""
+    child-expansion axis dropped, including its ``pg`` pair-group
+    unrolling (fori_loop over P/pg groups, pg unrolled pair bodies each).
+    Tiles whose rows are all beyond ``n_active`` skip the entire body:
+    this is where the incumbent-driven work reduction lands (the
+    reference's per-thread early exit, `evaluate.cu:73-91`, becomes
+    whole-tile skipping on the sequential TPU grid)."""
 
     @pl.when(pl.program_id(0) * tile < nact_ref[0])
     def _active():
@@ -731,14 +792,25 @@ def _lb2_self_kernel(
             )
             return jnp.maximum(lb, pair_lb)
 
-        lb = jax.lax.fori_loop(0, P, pair_body, jnp.zeros((T, 1), jnp.float32))
+        lb0 = jnp.zeros((T, 1), jnp.float32)
+        if pg > 1:
+            def group_body(g, lb):
+                q0 = g * pg
+                for j in range(pg):  # static unroll within the group
+                    lb = pair_body(q0 + j, lb)
+                return lb
+
+            lb = jax.lax.fori_loop(0, P // pg, group_body, lb0)
+        else:
+            lb = jax.lax.fori_loop(0, P, pair_body, lb0)
         out_ref[:] = lb.astype(jnp.int32)
 
 
 @lru_cache(maxsize=None)
 def _lb2_self_call(n: int, m: int, P: int, R: int, tile: int, interpret: bool,
-                   bf16: bool = False):
-    kernel = partial(_lb2_self_kernel, n=n, m=m, P=P, tile=tile, bf16=bf16)
+                   bf16: bool = False, pg: int = 1):
+    kernel = partial(_lb2_self_kernel, n=n, m=m, P=P, tile=tile, pg=pg,
+                     bf16=bf16)
     grid = (R // tile,)
     full = lambda i: (0, 0)
     full3 = lambda i: (0, 0, 0)
@@ -767,9 +839,27 @@ def _lb2_self_call(n: int, m: int, P: int, R: int, tile: int, interpret: bool,
     )
 
 
+_ORDERED_FIELDS = ("p0_o", "p1_o", "lag_o", "tails0", "tails1",
+                   "msel0", "msel1", "jorder")
+
+
+class _PaddedOrdered:
+    """Ordered tables padded to a pair-group multiple with copies of pair 0
+    (max over pairs is idempotent). Works on traced fields — the mp-sharded
+    path passes dynamic slices — and the pads are (reps, ...) slivers."""
+
+    def __init__(self, ordered, reps: int):
+        for f in _ORDERED_FIELDS:
+            arr = jnp.asarray(getattr(ordered, f))
+            setattr(self, f, jnp.concatenate(
+                [arr, jnp.repeat(arr[:1], reps, axis=0)], axis=0
+            ))
+
+
 def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
                                 interpret: bool | None = None,
-                                bf16: bool = False):
+                                bf16: bool = False,
+                                pair_group: int | None = None):
     """`pfsp_lb2_self_bounds` over EXPLICIT ordered tables (possibly traced
     slices of the full pair set — the mp-sharded staged path slices each
     shard's contiguous pair block before the call; pallas_call takes traced
@@ -779,12 +869,16 @@ def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
     R, n = prmu.shape
     m = ptm_t.shape[1]
     P = ordered.lag_o.shape[0]
-    tile = effective_tile("lb2self", n, m, P, batch=R)
+    pg = _resolve_pair_group("lb2self", n, P, pair_group)
+    reps = _round_up(P, pg) - P
+    if reps:
+        ordered = _PaddedOrdered(ordered, reps)
+    tile = effective_tile("lb2self", n, m, P, batch=R, pair_group=pg)
     Rp = _round_up(R, tile)
     if Rp != R:
         prmu = jnp.pad(prmu, ((0, Rp - R), (0, 0)))
         limit1 = jnp.pad(limit1, ((0, Rp - R),))
-    out = _lb2_self_call(n, m, P, Rp, tile, interpret, bf16)(
+    out = _lb2_self_call(n, m, P + reps, Rp, tile, interpret, bf16, pg)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
         jnp.asarray(n_active, dtype=jnp.int32).reshape(1),
@@ -803,14 +897,20 @@ def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
 
 def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
                          interpret: bool | None = None,
-                         bf16: bool | None = None):
+                         bf16: bool | None = None,
+                         pair_group: int | None = None):
     """(R,) int32 self lb2 bounds; rows >= n_active are garbage (their
     tiles are skipped entirely). Same contract as `_lb2_self_chunk` on the
     first n_active rows."""
     if bf16 is None:
         bf16 = getattr(tables, "exact_bf16", False)
-    ordered = (tables.johnson_ordered_device() if _eager_context()
-               else tables.johnson_ordered())
+    n = prmu.shape[-1]
+    pg = _resolve_pair_group("lb2self", n, tables.pairs.shape[0], pair_group)
+    # Tables pre-padded to the pair-group multiple: the cached device copy
+    # (eager) / host numpy (traced) avoid a per-call concat.
+    ordered = (tables.johnson_ordered_device(pg) if _eager_context()
+               else tables.johnson_ordered_mp(pg))
     return pfsp_lb2_self_bounds_tables(
         prmu, limit1, n_active, tables.ptm_t, ordered, interpret, bf16,
+        pair_group=pg,
     )

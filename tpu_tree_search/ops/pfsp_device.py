@@ -168,7 +168,36 @@ def _lb1_d_chunk(prmu, limit1, ptm_t, min_heads, min_tails, bf16: bool = False):
     return lb
 
 
-@partial(jax.jit, static_argnames=("bf16",))
+def _pad_pair_tables(pairs, lags, scheds, Pb: int):
+    """Pad the (P, ...) pair tables to a multiple of ``Pb`` with copies of
+    pair 0 (max over pairs is idempotent, so duplicates only re-max the same
+    value). Static shapes in, static shapes out — safe on traced arrays
+    (the mp-sharded paths pass dynamic slices)."""
+    P = pairs.shape[0]
+    reps = -(-P // Pb) * Pb - P
+    # tts-lint: waive tracer-branch -- reps is a Python int (static shape P and the static_argnames-bound Pb); the branch picks a padded vs unpadded program shape
+    if reps:
+        pairs = jnp.concatenate([pairs, jnp.repeat(pairs[:1], reps, 0)])
+        lags = jnp.concatenate([lags, jnp.repeat(lags[:1], reps, 0)])
+        scheds = jnp.concatenate([scheds, jnp.repeat(scheds[:1], reps, 0)])
+    return pairs, lags, scheds
+
+
+def _johnson_block_tables(pairs_b, lags_b, sched_b, ptm, min_tails):
+    """Slot-ordered Johnson tables of one pair block, derived in-trace.
+
+    pairs_b (Pb, 2), lags_b/sched_b (Pb, n), ptm (m, n) machine-major.
+    Returns p0_o/p1_o/lag_o (Pb, n) — the value of the t-th job of each
+    pair's Johnson schedule — plus tails0/tails1 (Pb,). The per-block gather
+    is tiny (Pb x n) next to the (B, ..., Pb, n) batch tensors it feeds.
+    """
+    p0_o = jnp.take_along_axis(ptm[pairs_b[:, 0]], sched_b, axis=1)
+    p1_o = jnp.take_along_axis(ptm[pairs_b[:, 1]], sched_b, axis=1)
+    lag_o = jnp.take_along_axis(lags_b, sched_b, axis=1)
+    return p0_o, p1_o, lag_o, min_tails[pairs_b[:, 0]], min_tails[pairs_b[:, 1]]
+
+
+@partial(jax.jit, static_argnames=("bf16", "pairblock"))
 def _lb2_chunk(
     prmu,
     limit1,
@@ -179,13 +208,23 @@ def _lb2_chunk(
     lags,
     johnson_schedules,
     bf16: bool = False,
+    pairblock: int = 1,
 ):
     """Bounds of every child under lb2 (`c_bound_johnson.c:239-254`; device:
     `pfsp_gpu_chpl.chpl:238-254` / `evaluate.cu:73-91`).
 
     Per child (i, k) and machine pair (ma0, ma1): the Johnson cmax of the
     free jobs with lags, via the closed-form max-plus scan (module
-    docstring). A fori_loop over machine pairs carries the running max.
+    docstring).
+
+    ``pairblock`` (static) batches the machine-pair axis: ``Pb`` pairs are
+    evaluated at once as an extra leading tensor axis over the slot-ordered
+    tables and max-reduced within the block; the running max carries across
+    the statically-unrolled blocks, so the compiled program contains NO
+    serial per-pair loop (the reference serializes exactly this loop,
+    `Bound_johnson.chpl:188-239`). ``pairblock=1`` keeps the original
+    serial ``fori_loop`` (the degenerate old behavior, still used by the
+    jaxpr-pin regression tests).
 
     Shapes: pairs (P, 2), lags/johnson_schedules (P, n).
     """
@@ -206,6 +245,38 @@ def _lb2_chunk(
 
     P = pairs.shape[0]
     ptm = ptm_t.T  # (m, n)
+    # Zero init derived from varying operands (not jnp.zeros) so the carry
+    # type matches under shard_map along both dp (prmu) and mp (lags) axes.
+    lb0 = prmu * 0 + 0 * jnp.min(lags).astype(jnp.int32)
+
+    if pairblock > 1:
+        Pb = min(pairblock, P)
+        pairs, lags, johnson_schedules = _pad_pair_tables(
+            pairs, lags, johnson_schedules, Pb
+        )
+
+        def block(lb, pairs_b, lags_b, sched_b):
+            p0_o, p1_o, lag_o, tl0, tl1 = _johnson_block_tables(
+                pairs_b, lags_b, sched_b, ptm, min_tails
+            )
+            u_o = u_child[:, :, sched_b]  # (B, k, Pb, n) ordered free flags
+            mp0 = u_o * p0_o[None, None]
+            mp1 = u_o * p1_o[None, None]
+            f0 = jnp.take(child_front, pairs_b[:, 0], axis=2)  # (B, k, Pb)
+            f1 = jnp.take(child_front, pairs_b[:, 1], axis=2)
+            t0 = f0[..., None] + jnp.cumsum(mp0, axis=-1)
+            suf1 = jnp.cumsum(mp1[..., ::-1], axis=-1)[..., ::-1]
+            a = jnp.where(u_o > 0, t0 + lag_o[None, None] + suf1, NEG_INF)
+            tmp1 = jnp.maximum(f1 + jnp.sum(mp1, axis=-1), jnp.max(a, axis=-1))
+            tmp0 = f0 + jnp.sum(mp0, axis=-1)
+            pair_lb = jnp.maximum(tmp1 + tl1[None, None], tmp0 + tl0[None, None])
+            return jnp.maximum(lb, jnp.max(pair_lb, axis=-1))
+
+        lb = lb0
+        for b in range(pairs.shape[0] // Pb):
+            sl = slice(b * Pb, (b + 1) * Pb)
+            lb = block(lb, pairs[sl], lags[sl], johnson_schedules[sl])
+        return lb
 
     def pair_body(q, lb):
         ma0 = pairs[q, 0]
@@ -231,9 +302,6 @@ def _lb2_chunk(
         pair_lb = jnp.maximum(tmp1 + min_tails[ma1], tmp0 + min_tails[ma0])
         return jnp.maximum(lb, pair_lb)
 
-    # Zero init derived from varying operands (not jnp.zeros) so the carry
-    # type matches under shard_map along both dp (prmu) and mp (lags) axes.
-    lb0 = prmu * 0 + 0 * jnp.min(lags).astype(jnp.int32)
     return jax.lax.fori_loop(0, P, pair_body, lb0)
 
 
@@ -328,16 +396,20 @@ class PFSPDeviceTables:
             )
         return self._johnson_ordered
 
-    def johnson_ordered_device(self):
+    def johnson_ordered_device(self, pad_to: int = 1):
         """Device-resident copy of the ordered tables for EAGER (un-jitted)
         kernel calls — without it every eager lb2 evaluation would pay a
         fresh host->device transfer of all eight arrays (the (P, n, n)
-        jorder alone is MBs). Callers must only invoke this OUTSIDE a
-        trace (`_eager_context()`), so the cache can never capture a
-        tracer; traced callers keep the numpy tables, which bake into the
-        executable as constants."""
-        if not hasattr(self, "_johnson_ordered_dev"):
-            o = self.johnson_ordered()
+        jorder alone is MBs). ``pad_to``: pair axis padded to this multiple
+        (the Pallas pair-group unroll), cached per multiple. Callers must
+        only invoke this OUTSIDE a trace (`_eager_context()`), so the
+        cache can never capture a tracer; traced callers keep the numpy
+        tables, which bake into the executable as constants."""
+        cache = getattr(self, "_johnson_ordered_dev", None)
+        if cache is None:
+            cache = self._johnson_ordered_dev = {}
+        if pad_to not in cache:
+            o = self.johnson_ordered_mp(pad_to)
 
             class _Dev:
                 pass
@@ -346,8 +418,8 @@ class PFSPDeviceTables:
             for f in ("p0_o", "p1_o", "lag_o", "tails0", "tails1",
                       "msel0", "msel1", "jorder"):
                 setattr(d, f, jnp.asarray(getattr(o, f)))
-            self._johnson_ordered_dev = d
-        return self._johnson_ordered_dev
+            cache[pad_to] = d
+        return cache[pad_to]
 
     def johnson_ordered_mp(self, mp_size: int):
         """Ordered tables over the mp-padded pair set (P rounded up to a
@@ -416,6 +488,58 @@ def _lb2_pallas_enabled() -> bool:
     return os.environ.get("TTS_PALLAS_LB2", "1") != "0"
 
 
+def _auto_pairblock(P: int, n: int) -> int:
+    """Auto pair-block policy: the largest power-of-two block whose
+    per-(row, child) working set stays near ~2048 ordered-slot lanes
+    (``Pb * n``), clamped to the pair count. At the published shapes this
+    gives Pb = P at ta014 (n=20, P=45 — a single block, loop-free) and
+    Pb = 64 at ta021 (P=190 — three unrolled blocks); 500-job instances
+    fall to Pb = 4 so the (B, n, Pb, n) intermediates keep fitting."""
+    per = max(4, 2048 // max(1, n))
+    pb = 4
+    # tts-lint: waive tracer-branch -- pure host policy on Python ints; P and n are static shapes at every call site (traced callers resolve the knob before tracing)
+    while pb * 2 <= per:
+        pb *= 2
+    return max(1, min(P, pb))
+
+
+def lb2_pairblock(P: int, n: int) -> int:
+    """Resolved lb2 pair-block size for a (P pairs, n jobs) shape.
+
+    ``TTS_LB2_PAIRBLOCK`` / ``--lb2-pairblock``: ``auto`` (default) applies
+    `_auto_pairblock`; an explicit positive integer forces the block size
+    (``1`` = the serial per-pair fori_loop, the pre-blocking behavior;
+    values above P clamp to P). Baked into compiled programs at trace
+    time, so `routing_cache_token` carries the resolved value."""
+    import os
+
+    knob = os.environ.get("TTS_LB2_PAIRBLOCK", "auto")
+    if knob == "auto":
+        return _auto_pairblock(P, n)
+    try:
+        v = int(knob)
+    except ValueError:
+        raise ValueError(
+            "TTS_LB2_PAIRBLOCK must be 'auto' or a positive integer, got "
+            f"{knob!r}"
+        ) from None
+    if v < 1:
+        raise ValueError(
+            f"TTS_LB2_PAIRBLOCK must be >= 1 (got {v}); 1 is the serial "
+            "per-pair loop"
+        )
+    return min(v, P)
+
+
+def lb2_kernel_pair_group(P: int, n: int) -> int:
+    """Pair-group unroll of the Pallas lb2 kernels: the same knob, capped
+    at 8 — the kernel VMEM model charges the per-pair live values once per
+    unrolled group member (`pallas_kernels._model_bytes`), and 8 is the
+    largest group whose modeled footprint keeps MXU-efficient batch tiles
+    at the published shapes."""
+    return min(lb2_pairblock(P, n), 8)
+
+
 def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     """lb2 chunk bounds, routed like ``lb1_bounds``. The Pallas kernel keeps
     the whole Johnson pair loop in VMEM — the jnp path's per-pair (B, n, n)
@@ -425,17 +549,20 @@ def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     # lb2's (P, n, n) slot-order tables cap the kernel at ~100 jobs
     # (ta031-ta090); beyond that the jnp path has the same asymptotic cost.
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    P = tables.pairs.shape[0]
     if (PK.use_pallas(device) and _lb2_pallas_enabled() and n <= 100
-            and PK.lb2_kernel_feasible(n, m, tables.pairs.shape[0])):
-        return PK.pfsp_lb2_bounds(prmu, limit1, tables)
+            and PK.lb2_kernel_feasible(n, m, P)):
+        return PK.pfsp_lb2_bounds(
+            prmu, limit1, tables, pair_group=lb2_kernel_pair_group(P, n)
+        )
     return _lb2_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
         tables.pairs, tables.lags, tables.johnson_schedules,
-        bf16=tables.exact_bf16,
+        bf16=tables.exact_bf16, pairblock=lb2_pairblock(P, n),
     )
 
 
-@partial(jax.jit, static_argnames=("bf16",))
+@partial(jax.jit, static_argnames=("bf16", "pairblock"))
 def _lb2_self_chunk(
     prmu,
     limit1,
@@ -446,12 +573,14 @@ def _lb2_self_chunk(
     lags,
     johnson_schedules,
     bf16: bool = False,
+    pairblock: int = 1,
 ):
     """lb2 of each ROW as a node (not of its children): the Johnson bound of
     the row's own partial schedule (`lb2_bound`, `c_bound_johnson.c:239-254`
     applied to the node itself). The staged evaluator feeds compacted child
     rows here — same closed-form max-plus scan as `_lb2_chunk` with the
-    child-expansion axis dropped. Returns (R,) int32."""
+    child-expansion axis dropped, and the same ``pairblock`` batching of
+    the machine-pair axis. Returns (R,) int32."""
     R, n = prmu.shape
     front, _, ptg, unsched = _parent_state(prmu, limit1, ptm_t, min_heads, bf16)
     # Free flags by job id for the row itself.
@@ -461,6 +590,36 @@ def _lb2_self_chunk(
 
     P = pairs.shape[0]
     ptm = ptm_t.T  # (m, n)
+    lb0 = prmu[:, 0] * 0 + 0 * jnp.min(lags).astype(jnp.int32)
+
+    if pairblock > 1:
+        Pb = min(pairblock, P)
+        pairs, lags, johnson_schedules = _pad_pair_tables(
+            pairs, lags, johnson_schedules, Pb
+        )
+
+        def block(lb, pairs_b, lags_b, sched_b):
+            p0_o, p1_o, lag_o, tl0, tl1 = _johnson_block_tables(
+                pairs_b, lags_b, sched_b, ptm, min_tails
+            )
+            u_o = u[:, sched_b]  # (R, Pb, n) ordered free flags
+            mp0 = u_o * p0_o[None]
+            mp1 = u_o * p1_o[None]
+            f0 = jnp.take(front, pairs_b[:, 0], axis=1)  # (R, Pb)
+            f1 = jnp.take(front, pairs_b[:, 1], axis=1)
+            t0 = f0[..., None] + jnp.cumsum(mp0, axis=-1)
+            suf1 = jnp.cumsum(mp1[..., ::-1], axis=-1)[..., ::-1]
+            a = jnp.where(u_o > 0, t0 + lag_o[None] + suf1, NEG_INF)
+            tmp1 = jnp.maximum(f1 + jnp.sum(mp1, axis=-1), jnp.max(a, axis=-1))
+            tmp0 = f0 + jnp.sum(mp0, axis=-1)
+            pair_lb = jnp.maximum(tmp1 + tl1[None], tmp0 + tl0[None])
+            return jnp.maximum(lb, jnp.max(pair_lb, axis=-1))
+
+        lb = lb0
+        for b in range(pairs.shape[0] // Pb):
+            sl = slice(b * Pb, (b + 1) * Pb)
+            lb = block(lb, pairs[sl], lags[sl], johnson_schedules[sl])
+        return lb
 
     def pair_body(q, lb):
         ma0 = pairs[q, 0]
@@ -486,7 +645,6 @@ def _lb2_self_chunk(
         pair_lb = jnp.maximum(tmp1 + min_tails[ma1], tmp0 + min_tails[ma0])
         return jnp.maximum(lb, pair_lb)
 
-    lb0 = prmu[:, 0] * 0 + 0 * jnp.min(lags).astype(jnp.int32)
     return jax.lax.fori_loop(0, P, pair_body, lb0)
 
 
@@ -499,13 +657,17 @@ def lb2_self_bounds(prmu, limit1, n_active, tables: "PFSPDeviceTables",
     from . import pallas_kernels as PK
 
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    P = tables.pairs.shape[0]
     if (PK.use_pallas(device) and _lb2_pallas_enabled() and n <= 100
-            and PK.lb2_self_kernel_feasible(n, m, tables.pairs.shape[0])):
-        return PK.pfsp_lb2_self_bounds(prmu, limit1, n_active, tables)
+            and PK.lb2_self_kernel_feasible(n, m, P)):
+        return PK.pfsp_lb2_self_bounds(
+            prmu, limit1, n_active, tables,
+            pair_group=lb2_kernel_pair_group(P, n),
+        )
     return _lb2_self_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
         tables.pairs, tables.lags, tables.johnson_schedules,
-        bf16=tables.exact_bf16,
+        bf16=tables.exact_bf16, pairblock=lb2_pairblock(P, n),
     )
 
 
@@ -551,14 +713,18 @@ def lb2_self_bounds_mp(prmu, limit1, n_active, tables: "PFSPDeviceTables",
         local = PK.pfsp_lb2_self_bounds_tables(
             prmu, limit1, n_active, tables.ptm_t, sliced,
             bf16=tables.exact_bf16,
+            pair_group=lb2_kernel_pair_group(P_local, n),
         )
     else:
         prs = jax.lax.dynamic_slice_in_dim(pairs, start, P_local, axis=0)
         lgs = jax.lax.dynamic_slice_in_dim(lags, start, P_local, axis=0)
         sch = jax.lax.dynamic_slice_in_dim(scheds, start, P_local, axis=0)
+        # Pair-blocking composes with the mp slicing: each shard blocks its
+        # own P/mp pair subset (a smaller P just means fewer blocks).
         local = _lb2_self_chunk(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
             prs, lgs, sch, bf16=tables.exact_bf16,
+            pairblock=lb2_pairblock(P_local, n),
         )
     return jax.lax.pmax(local, mp_axis)
 
@@ -624,6 +790,10 @@ def routing_cache_token(problem, device=None) -> tuple:
         tok += (
             _lb2_pallas_enabled(),
             lb2_staged_enabled(device, problem.jobs),
+            # The resolved pair-block size (TTS_LB2_PAIRBLOCK) is baked
+            # into the evaluator at trace time; the kernel pair group is a
+            # pure function of it, so one entry covers both paths.
+            lb2_pairblock(problem.lb2_data.pairs.shape[0], problem.jobs),
         )
     return tok
 
@@ -703,9 +873,12 @@ def lb2_bounds_mp(prmu, limit1, tables: "PFSPDeviceTables", mp_axis: str,
     prs = jax.lax.dynamic_slice_in_dim(pairs, start, P_local, axis=0)
     lgs = jax.lax.dynamic_slice_in_dim(lags, start, P_local, axis=0)
     sch = jax.lax.dynamic_slice_in_dim(scheds, start, P_local, axis=0)
+    # Pair-blocking applies within each shard's P/mp subset (fewer blocks,
+    # same math) — the pair axis composes with the mp slicing.
     local = _lb2_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
         prs, lgs, sch, bf16=tables.exact_bf16,
+        pairblock=lb2_pairblock(P_local, prmu.shape[-1]),
     )
     return jax.lax.pmax(local, mp_axis)
 

@@ -151,55 +151,70 @@ class JaxCollectives:
     """Real multi-host collectives over jax.distributed (DCN). The launcher
     must have called ``jax.distributed.initialize``; every host participates
     in every call (the reductions happen only at start/end, mirroring the
-    MPI baseline's join-point-only communication, SURVEY.md §2.5)."""
+    MPI baseline's join-point-only communication, SURVEY.md §2.5).
+
+    The whole control plane rides the coordination service (the same
+    DCN-backed KV store jax.distributed itself uses for barriers) rather
+    than XLA array collectives: control tuples are a few hundred bytes at
+    exchange boundaries, where a device dispatch per round would cost more
+    than it moves — and a dead peer surfaces as a bounded-timeout error
+    here (fail-stop with a root cause) instead of a hung collective."""
+
+    #: Bounded wait for any single control-plane step (a peer's round blob,
+    #: the cleanup barrier): seconds here mean a dead or wedged peer, so
+    #: the exchange raises — fail-stop — instead of hanging the search.
+    AG_TIMEOUT_S = 120.0
 
     def __init__(self):
         import jax
 
         self.num_hosts = jax.process_count()
         self.host_id = jax.process_index()
-
-    def _allgather(self, value):
-        from jax.experimental import multihost_utils
-
-        return np.asarray(
-            multihost_utils.process_allgather(np.asarray([value]))
-        ).reshape(-1)
+        self._round = 0  # per-call key uniqueness (all hosts count together)
 
     def allreduce_sum(self, value):
-        return type(value)(self._allgather(value).sum())
+        return type(value)(sum(self.allgather_obj(value)))
 
     def allreduce_min(self, value):
-        return type(value)(self._allgather(value).min())
+        return type(value)(min(self.allgather_obj(value)))
 
     def allreduce_max(self, value):
-        return type(value)(self._allgather(value).max())
+        return type(value)(max(self.allgather_obj(value)))
 
     def allgather_obj(self, value) -> list:
-        """Arbitrary-object allgather over DCN: two rounds (lengths, then a
-        max-length-padded byte buffer). Only small control tuples travel this
-        way — node payloads go point-to-point via the KV store (``kv_set`` /
-        ``kv_get``), never broadcast."""
+        """RAGGED arbitrary-object allgather: each host posts its pickled
+        blob once at a round-unique key and every peer reads exactly the
+        bytes each sender wrote — the exchange payload scales with the
+        actual sizes (sum of the blobs per receiver), not H x max-length
+        as the old padded array-allgather did. Only small control tuples
+        travel this way — node payloads go point-to-point via ``kv_set`` /
+        ``kv_get``, never all-to-all. Cleanup: a blob has H-1 readers, so
+        the sender may only delete its key after the round's barrier
+        proves every peer has read it (kv_get's delete-after-first-read
+        would lose it for the rest)."""
         import pickle
 
-        from jax.experimental import multihost_utils
-
-        data = np.frombuffer(pickle.dumps(value), dtype=np.uint8)
-        lens = np.asarray(
-            multihost_utils.process_allgather(
-                np.asarray([len(data)], dtype=np.int64)
-            )
-        ).reshape(-1)
-        mx = int(lens.max())
-        buf = np.zeros((mx,), dtype=np.uint8)
-        buf[: len(data)] = data
-        gathered = np.asarray(
-            multihost_utils.process_allgather(buf)
-        ).reshape(self.num_hosts, mx)
-        return [
-            pickle.loads(gathered[h, : int(lens[h])].tobytes())
-            for h in range(self.num_hosts)
-        ]
+        if self.num_hosts == 1:
+            return [value]
+        client = self._client()
+        r = self._round
+        self._round += 1
+        me = self.host_id
+        tmo_ms = int(self.AG_TIMEOUT_S * 1000)
+        client.key_value_set_bytes(f"tts/agobj/{r}/{me}", pickle.dumps(value))
+        out = []
+        for h in range(self.num_hosts):
+            if h == me:
+                out.append(value)
+            else:
+                out.append(pickle.loads(
+                    client.blocking_key_value_get_bytes(
+                        f"tts/agobj/{r}/{h}", tmo_ms
+                    )
+                ))
+        client.wait_at_barrier(f"tts/agobj/{r}/done", tmo_ms)
+        client.key_value_delete(f"tts/agobj/{r}/{me}")
+        return out
 
     @staticmethod
     def _client():

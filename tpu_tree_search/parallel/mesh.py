@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..obs import events as ev
+from ..utils import jax_compat
 from ..problems.base import INF_BOUND
 
 
@@ -90,7 +91,7 @@ class MeshEvaluator:
         core = nqueens_device.make_core(problem.N, problem.g)
 
         @partial(
-            jax.shard_map,
+            jax_compat.shard_map,
             mesh=mesh,
             in_specs=({"depth": P("dp"), "board": P("dp", None)},),
             out_specs=P("dp", None),
@@ -134,7 +135,7 @@ class MeshEvaluator:
                 P("mp", None),  # johnson_schedules
             )
 
-            @partial(jax.shard_map, mesh=mesh, in_specs=(*in_specs, P()),
+            @partial(jax_compat.shard_map, mesh=mesh, in_specs=(*in_specs, P()),
                      out_specs=(P("dp", None), P()))
             def step(parents, best, ptm_t, min_heads, min_tails, prs, lgs, sch, count):
                 local = pfsp_device._lb2_chunk(
@@ -157,7 +158,7 @@ class MeshEvaluator:
             )
             in_specs = (node_spec, P(), P(None, None), P(None), P(None))
 
-            @partial(jax.shard_map, mesh=mesh, in_specs=(*in_specs, P()),
+            @partial(jax_compat.shard_map, mesh=mesh, in_specs=(*in_specs, P()),
                      out_specs=(P("dp", None), P()))
             def step(parents, best, ptm_t, min_heads, min_tails, count):
                 bounds = chunk(

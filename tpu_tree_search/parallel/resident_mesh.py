@@ -48,6 +48,7 @@ from ..obs import events as ev
 from ..ops import pallas_kernels as PK
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, index_batch
+from ..utils import jax_compat
 
 
 def make_dp_mp_mesh(devices, D: int, mp: int):
@@ -176,8 +177,9 @@ class _MeshResidentProgram:
                 cycles += cy
                 # Incumbent all-reduce over ICI (north-star improvement).
                 # pcast re-marks the reduced (axis-invariant) value as
-                # varying so the next round's while-loop carry types match.
-                bst = lax.pcast(lax.pmin(bst, axis), (axis,), to="varying")
+                # varying so the next round's while-loop carry types match
+                # (identity on pre-vma jax — jax_compat).
+                bst = jax_compat.pcast_varying(lax.pmin(bst, axis), axis)
                 if D > 1:
                     # -- diffusion balance round -------------------------------
                     sizes = lax.all_gather(sz, axis)  # (D,)
@@ -260,7 +262,7 @@ class _MeshResidentProgram:
         )
         if obs:
             out_specs = out_specs + (P(axis, None),)
-        mapped = jax.shard_map(
+        mapped = jax_compat.shard_map(
             shard_step,
             mesh=mesh,
             in_specs=(specs_pool, specs_vec, specs_vec, specs_vec),
@@ -297,7 +299,7 @@ class _MeshResidentProgram:
                 pa = lax.dynamic_update_slice(pa, fr_a[0].astype(aux_dt), (0,))
                 return pv, pa, cnt, b0
 
-            return jax.shard_map(
+            return jax_compat.shard_map(
                 shard_init,
                 mesh=mesh,
                 in_specs=(P(axis, None, None), P(axis, None), specs_vec, specs_vec),
@@ -315,7 +317,7 @@ class _MeshResidentProgram:
             def shard_res(pv, pa):
                 return pv[None, :R], pa[None, :R]
 
-            return jax.shard_map(
+            return jax_compat.shard_map(
                 shard_res,
                 mesh=mesh,
                 in_specs=(specs_pool, specs_vec),

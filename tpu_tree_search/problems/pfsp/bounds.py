@@ -178,15 +178,39 @@ def lb1_children_bounds(d: LB1Data, prmu, limit1: int, limit2: int) -> np.ndarra
 
 
 # ---------------------------------------------------------------------------
-# lb2 — two-machine / Johnson bound (c_bound_johnson.c), LB2_FULL variant
+# lb2 — two-machine / Johnson bound (c_bound_johnson.c)
 # ---------------------------------------------------------------------------
+
+
+#: The reference's ``enum lb2_variant`` pair subsets (`Bound_johnson.chpl:6`,
+#: `fill_machine_pairs` `:50-88`): ``full`` takes every (i, j) with i < j
+#: (P = m(m-1)/2, the default of every reference tier); ``nabeshima`` the
+#: adjacent pairs (i, i+1) [Nabeshima'67]; ``lageweg`` every machine paired
+#: with the last, (i, m-1) [Lageweg'78] — both P = m-1. (LB2_LEARN reuses
+#: the full pair set with a learned visit order; visit order only matters
+#: for the early exit, which the TPU formulation drops, so it is not a
+#: distinct table shape here.)
+LB2_VARIANTS = ("full", "nabeshima", "lageweg")
+
+
+def machine_pairs(m: int, variant: str = "full") -> list[tuple[int, int]]:
+    """The `fill_machine_pairs` pair subsets, one list per variant."""
+    if variant == "full":
+        return [(i, j) for i in range(m - 1) for j in range(i + 1, m)]
+    if variant == "nabeshima":
+        return [(i, i + 1) for i in range(m - 1)]
+    if variant == "lageweg":
+        return [(i, m - 1) for i in range(m - 1)]
+    raise ValueError(
+        f"lb2_variant must be one of {LB2_VARIANTS}, got {variant!r}"
+    )
 
 
 @dataclass
 class LB2Data:
     """Per-instance tables for lb2 (`c_bound_johnson.h:16-27`)."""
 
-    pairs: np.ndarray  # (P, 2) int32 machine pairs (m1 < m2), LB2_FULL
+    pairs: np.ndarray  # (P, 2) int32 machine pairs (m1 < m2)
     lags: np.ndarray  # (P, jobs) int32 — q_iuv term [Lageweg'78]
     johnson_schedules: np.ndarray  # (P, jobs) int32 — job ids in Johnson order
 
@@ -195,9 +219,10 @@ class LB2Data:
         return self.pairs.shape[0]
 
 
-def make_lb2(d: LB1Data) -> LB2Data:
-    """Build lb2 tables: machine pairs (`c_bound_johnson.c:48-91`, LB2_FULL),
-    lags (`:94-109`), and per-pair Johnson-optimal schedules (`:147-178`).
+def make_lb2(d: LB1Data, variant: str = "full") -> LB2Data:
+    """Build lb2 tables: machine pairs (`c_bound_johnson.c:48-91`, subset per
+    ``variant`` — see `LB2_VARIANTS`), lags (`:94-109`), and per-pair
+    Johnson-optimal schedules (`:147-178`).
 
     The Johnson sort uses a *stable* argsort on key (partition, ptm1 | -ptm2):
     partition 0 (ptm1 < ptm2) first by ascending ptm1, then partition 1 by
@@ -207,7 +232,7 @@ def make_lb2(d: LB1Data) -> LB2Data:
     """
     p = d.p_times.astype(np.int64)
     m, n = p.shape
-    pair_list = [(i, j) for i in range(m - 1) for j in range(i + 1, m)]
+    pair_list = machine_pairs(m, variant)
     pairs = np.array(pair_list, dtype=np.int32).reshape(-1, 2)
     P = pairs.shape[0]
 

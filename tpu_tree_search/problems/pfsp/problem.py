@@ -34,14 +34,23 @@ class PFSPProblem(Problem):
         lb: str = "lb1",
         ub: int = 1,
         p_times: np.ndarray | None = None,
+        lb2_variant: str = "full",
     ):
         """``p_times`` overrides the Taillard instance (for reduced test
         instances); then ``ub`` must be 0 (no table optimum exists).
+        ``lb2_variant`` selects the Johnson machine-pair subset
+        (`bounds.LB2_VARIANTS`; the reference's `enum lb2_variant`,
+        `Bound_johnson.chpl:6`).
         """
         if lb not in ALLOWED_LOWER_BOUNDS:
             raise ValueError("Error - Unsupported lower bound")
         if ub not in (0, 1):
             raise ValueError("Error: unsupported upper bound initialization")
+        if lb2_variant not in B.LB2_VARIANTS:
+            raise ValueError(
+                f"Error - Unsupported lb2 variant: {lb2_variant!r} "
+                f"(choose from {B.LB2_VARIANTS})"
+            )
         if p_times is None:
             if not (1 <= inst <= 120):
                 raise ValueError("Error: unsupported Taillard's instance")
@@ -58,11 +67,12 @@ class PFSPProblem(Problem):
             self.inst = None
         self.lb = lb
         self.ub = ub
+        self.lb2_variant = lb2_variant
         self.jobs = int(p_times.shape[1])
         self.machines = int(p_times.shape[0])
         self.child_slots = self.jobs
         self.lb1_data = B.make_lb1(p_times)
-        self.lb2_data = B.make_lb2(self.lb1_data)
+        self.lb2_data = B.make_lb2(self.lb1_data, lb2_variant)
 
     def node_fields(self):
         return {

@@ -1,0 +1,47 @@
+"""Version shims for the narrow band of jax APIs this repo tracks.
+
+The codebase is written against current jax (``jax.shard_map`` with
+varying-manual-axes checking, ``lax.pcast``, ``pltpu.CompilerParams``);
+older installs (0.4.x) expose the same functionality under earlier names
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``,
+``pltpu.TPUCompilerParams``) and predate the vma type system entirely.
+Every call site routes through these wrappers so the version probe lives
+in exactly one place; each wrapper degrades to the semantically closest
+older behavior rather than stubbing anything out.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` when available, else the ``jax.experimental``
+    original. ``check_vma`` maps onto the older ``check_rep``: both gate the
+    per-shard type/replication checker that pallas_call does not yet
+    satisfy (see the resident_mesh call site)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # The pre-vma checker (check_rep) has no replication rule for
+    # while_loop at all — every resident mesh program would die at trace
+    # time — so it is forced off here; the real vma checking only exists
+    # (and stays on) under current jax.
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def pcast_varying(x, axis_name: str):
+    """Re-mark an axis-invariant value as varying over ``axis_name`` so a
+    while-loop carry keeps a consistent vma type (`lax.pcast`). Pre-vma jax
+    has no such typing — the identity is exact there."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, (axis_name,), to="varying")
