@@ -555,7 +555,62 @@ def eval_microbench(problem, on_tpu: bool, iters: int | None = None) -> dict:
     }
 
 
-COMPACT_MODES = ("scatter", "sort", "search")
+COMPACT_MODES = ("scatter", "sort", "search", "dense")
+
+
+def eval_cycle_ms(problem, m: int, M: int, cycles: int = 64) -> float | None:
+    """Measured evaluator-in-loop cost per cycle at the production chunk
+    shape: a stripped while_loop whose body runs ONLY the evaluator — no
+    pop, no compaction, no push (scripts/cycle_profile.py's c-loop, inlined
+    so pick_compact can price the survivor path per mode).  A mode's
+    maintenance share is then its measured cycle_ms minus this; the
+    on-device ``push_rows`` counter carries the matching WORK series
+    (docs/OBSERVABILITY.md).  Returns None on any failure — the
+    decomposition is best-effort and must never cost the bench line."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        from tpu_tree_search.engine.resident import (
+            _make_program,
+            resolve_capacity,
+        )
+
+        capacity, M = resolve_capacity(problem, M, None)
+        prog = _make_program(problem, m, M, cycles, capacity,
+                             jax.devices()[0])
+        evaluate = prog._make_eval()
+        n = problem.child_slots
+        vals = jnp.asarray(np.tile(np.arange(n, dtype=np.int32), (M, 1)))
+        aux = jnp.zeros((M,), jnp.int32)
+        valid = jnp.ones((M,), bool)
+        ub = jnp.int32(min(getattr(problem, "initial_ub", 2**30), 2**30))
+
+        def body(carry):
+            best, tree, cyc = carry
+            keep, sol_inc, best = evaluate(vals, aux, valid, best)
+            # Fold keep into the carry so nothing is dead-code-eliminated.
+            tree = tree + jnp.sum(keep, dtype=jnp.int32) + sol_inc * 0
+            return best, tree, cyc + 1
+
+        fn = jax.jit(lambda: lax.while_loop(
+            lambda c: c[2] < cycles, body, (ub, jnp.int32(0), jnp.int32(0))
+        ))
+
+        def block(out):
+            for x in out:
+                if hasattr(x, "block_until_ready"):
+                    x.block_until_ready()
+            return out
+
+        block(fn())  # compile + warm
+        t0 = time.time()
+        block(fn())
+        return round(1e3 * (time.time() - t0) / cycles, 3)
+    except Exception:  # noqa: BLE001 — calibration is best-effort
+        return None
 
 
 @contextmanager
@@ -591,12 +646,20 @@ def _mode_timeout(seconds: float | None):
         signal.signal(signal.SIGALRM, old)
 
 
-def pick_compact(run_fn, parity_fn, budget_s: float | None = None):
+def pick_compact(run_fn, parity_fn, budget_s: float | None = None,
+                 eval_ms: float | None = None, auto_mode: str | None = None):
     """Measure ``run_fn()`` under each compaction mode (TTS_COMPACT) and
     pick the fastest PARITY-PASSING one (fallback: fastest overall — a
     fast-but-wrong mode must never displace a clean measurement, but if
     none is clean the caller's own parity gate reports it). Per-mode
     failures are recorded, never fatal.
+
+    The stats blob records WHY a mode won, not just that it did: per mode,
+    the measured device ms/cycle and — when the caller supplies the
+    evaluator-only calibration ``eval_ms`` (``eval_cycle_ms``) — the
+    implied maintenance (pop+compact+push) ms/cycle; ``auto_mode`` records
+    what ``TTS_COMPACT=auto`` would have resolved for this config, so the
+    artifact shows whether the policy table agrees with the measurement.
 
     ``budget_s`` is a HARD bound on the whole A/B, not just a start gate:
     each mode runs inside its remaining slice of the budget under
@@ -638,6 +701,18 @@ def pick_compact(run_fn, parity_fn, budget_s: float | None = None):
         runs[mode] = r
         nps[mode] = round(r[1], 1)
         par[mode] = bool(parity_fn(r))
+    decomp = {}
+    for mode, r in runs.items():
+        # r = (result, nps, elapsed, device_phase): per-mode cycle cost
+        # from the run's own diagnostics (guarded — unit tests pass stubs).
+        cyc = getattr(getattr(r[0], "diagnostics", None),
+                      "kernel_launches", 0)
+        if cyc and r[3]:
+            row = {"cycle_ms": round(1e3 * r[3] / cyc, 3)}
+            if eval_ms is not None:
+                row["eval_ms"] = eval_ms
+                row["maint_ms"] = round(row["cycle_ms"] - eval_ms, 3)
+            decomp[mode] = row
     if not runs:
         # Preserve the per-mode diagnostics even when every mode failed —
         # the caller falls back to a plain run, but the record must show
@@ -650,6 +725,8 @@ def pick_compact(run_fn, parity_fn, budget_s: float | None = None):
         "picked": pick,
         "nodes_per_sec": nps,
         "parity": par,
+        **({"decomp": decomp} if decomp else {}),
+        **({"auto": auto_mode} if auto_mode is not None else {}),
         **({"errors": errors} if errors else {}),
         **({"skipped_budget": skipped} if skipped else {}),
     }
@@ -797,16 +874,24 @@ def main() -> int:
         best_run = None
         if on_tpu and not express:
             # Empirical compaction pick (cf. the jnp-vs-Pallas pick above):
-            # scatter serializes on TPU, sort loses on CPU — measure each
-            # on the production config, bank the winner, record all. One
-            # problem instance is fine: the program cache keys on the
-            # routing token, which includes TTS_COMPACT.
+            # scatter serializes on TPU, sort loses on CPU, dense is the
+            # shift-based fast path — measure each on the production
+            # config, bank the winner, record all plus the per-mode cycle
+            # decomposition (evaluator vs maintenance). One problem
+            # instance is fine: the program cache keys on the routing
+            # token, which includes TTS_COMPACT.
+            from tpu_tree_search.ops.compaction import resolve_compact_mode
+
             compact_stats, best_run = pick_compact(
                 _headline_run,
                 lambda r: (r[0].explored_tree == GOLDEN_LB1["tree"]
                            and r[0].explored_sol == GOLDEN_LB1["sol"]
                            and r[0].best == GOLDEN_LB1["makespan"]),
                 budget_s=600.0,
+                eval_ms=eval_cycle_ms(prob_hl, 25, HEADLINE_M),
+                auto_mode=resolve_compact_mode(
+                    prob_hl, HEADLINE_M, prob_hl.jobs, jax.devices()[0]
+                ),
             )
         if best_run is not None:
             res, nps, elapsed, device_phase = best_run
@@ -973,12 +1058,17 @@ def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
         if on_tpu:
             # Same empirical compaction pick as the headline — lb2 runs are
             # ~1s each at the tuned chunk size, so the A/B is nearly free.
+            from tpu_tree_search.ops.compaction import resolve_compact_mode
+
+            _p2 = PFSPProblem(inst=14, lb="lb2", ub=1)
             lb2_compact, lb2_best = pick_compact(
                 _lb2_run,
                 lambda r: (r[0].explored_tree == GOLDEN_LB2["tree"]
                            and r[0].explored_sol == GOLDEN_LB2["sol"]
                            and r[0].best == GOLDEN_LB2["makespan"]),
                 budget_s=300.0,
+                eval_ms=eval_cycle_ms(_p2, lb2_m, lb2_M),
+                auto_mode=resolve_compact_mode(_p2, lb2_M, _p2.jobs),
             )
         if lb2_best is not None:
             res2, nps2, _, _ = lb2_best
@@ -1036,10 +1126,15 @@ def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
         # costs the probe, never the N=15 record.
         nq_compact = None
         if on_tpu:
+            from tpu_tree_search.ops.compaction import resolve_compact_mode
+
+            _pq = NQueensProblem(N=14)
             nq_compact, _ = pick_compact(
                 lambda: run_config(NQueensProblem(N=14), m=25, M=65536),
                 lambda r: r[0].explored_sol == NQ_SOL[14],
                 budget_s=420.0,
+                eval_ms=eval_cycle_ms(_pq, 25, 65536, cycles=16),
+                auto_mode=resolve_compact_mode(_pq, 65536, _pq.N),
             )
             if nq_compact is not None:
                 # The stats were measured on the PROBE config, not N=15 —

@@ -13,8 +13,15 @@ in docs/HW_VALIDATION.md ("chunk-size tuning").
 Run on the TPU host:
     python scripts/headline_tune.py [--quick]              # ta014 lb1
     python scripts/headline_tune.py --problem nqueens      # N-Queens N=15
+    python scripts/headline_tune.py --problem nqueens --N 16   # bounded
 (N-Queens has no pruning, so its frontier FILLS large chunks — the sweep
-spans upward to find whether bigger-than-65536 chunks pay.)
+spans upward to find whether bigger-than-65536 chunks pay.  This is the
+first-ever N-Queens chunk-size sweep, VERDICT r5 #2: N=15 rows are full
+runs with solution-count parity; N=16/17 trees cost minutes-to-hours, so
+their rows are BOUNDED-dispatch rate rows — ``max_steps`` cuts after a few
+K-cycle dispatches and parity is not computable on a cutoff.  Rows are
+tagged with the resolved survivor path (``compact``), so the armed-session
+log doubles as the fused-vs-scatter A/B when driven with TTS_COMPACT.)
 """
 
 from __future__ import annotations
@@ -30,15 +37,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import GOLDEN_LB1, NQ_SOL, REF_C_SEQ  # noqa: E402 — canonical anchors
 
 
-def run_one(problem_name: str, M: int, K: int) -> dict:
+def run_one(problem_name: str, M: int, K: int, N: int = 15,
+            max_steps: int | None = None) -> dict:
     from tpu_tree_search.engine.resident import resident_search
 
     if problem_name == "nqueens":
         from tpu_tree_search.problems import NQueensProblem
 
-        mk = lambda: NQueensProblem(N=15)
-        anchor = REF_C_SEQ["nqueens_n15"]
-        check = lambda r: r.explored_sol == NQ_SOL[15]
+        mk = lambda: NQueensProblem(N=N)
+        anchor = REF_C_SEQ.get(f"nqueens_n{N}")
+        check = (
+            (lambda r: r.explored_sol == NQ_SOL[N]) if N in NQ_SOL
+            and max_steps is None else (lambda r: r.explored_tree > 0)
+        )
     else:
         from tpu_tree_search.problems import PFSPProblem
 
@@ -52,10 +63,12 @@ def run_one(problem_name: str, M: int, K: int) -> dict:
     # ONE instance for warm + timed: compiled programs are cached on the
     # problem object, so a fresh instance would re-trace inside the timed
     # run and inflate every measurement.
+    kw = {} if max_steps is None else {"max_steps": max_steps}
     prob = mk()
-    resident_search(prob, m=25, M=M, K=K)  # compile + warm
+    resident_search(prob, m=25, M=M, K=K,
+                    **({} if max_steps is None else {"max_steps": 1}))
     t0 = time.time()
-    res = resident_search(prob, m=25, M=M, K=K)
+    res = resident_search(prob, m=25, M=M, K=K, **kw)
     elapsed = time.time() - t0
     device_phase = (
         res.phases[1].seconds if len(res.phases) > 1 else res.elapsed
@@ -64,12 +77,16 @@ def run_one(problem_name: str, M: int, K: int) -> dict:
     nps = res.explored_tree / max(device_phase, 1e-9)
     return {
         "problem": problem_name, "M": M, "K": K,
+        **({"N": N} if problem_name == "nqueens" else {}),
+        **({"bounded_steps": max_steps} if max_steps is not None else {}),
         # Trace-time knobs that change what this row measured — without
-        # them an A/B session log's rows are indistinguishable.
-        "compact": os.environ.get("TTS_COMPACT", "scatter"),
+        # them an A/B session log's rows are indistinguishable.  The
+        # resolved survivor path comes from the run itself (under the
+        # default auto knob the env alone no longer names it).
+        "compact": res.compact or os.environ.get("TTS_COMPACT", "auto"),
         "pallas": os.environ.get("TTS_PALLAS", "1") != "0",
         "nodes_per_sec": round(nps, 1),
-        "vs_ref_c_seq": round(nps / anchor, 3),
+        **({"vs_ref_c_seq": round(nps / anchor, 3)} if anchor else {}),
         "device_phase_s": round(device_phase, 3),
         "total_s": round(elapsed, 3),
         "cycles": cycles,
@@ -83,8 +100,13 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--problem", choices=["pfsp", "nqueens"], default="pfsp")
+    ap.add_argument("--N", type=int, default=15, choices=[15, 16, 17],
+                    help="N-Queens size: 15 = full runs with parity; "
+                    "16/17 = bounded-dispatch rate rows (the tree is too "
+                    "big to finish in a sweep slot)")
     args = ap.parse_args()
 
+    max_steps = None
     if args.problem == "nqueens":
         # No pruning -> the frontier fills any chunk; sweep UP from the
         # current 65536 to find where padded-compute cost overtakes fill.
@@ -94,6 +116,11 @@ def main() -> int:
             [(8192, 4096), (32768, 4096), (65536, 4096), (131072, 4096),
              (262144, 4096)]
         )
+        if args.N > 15:
+            # Bounded rate rows: a handful of K-cycle dispatches measures
+            # steady-state nodes/s without paying the full tree.
+            max_steps = 4
+            grid = [(M, 64) for M, _ in grid]
     else:
         grid = (
             [(1024, 4096), (2048, 4096), (4096, 4096)]
@@ -108,7 +135,8 @@ def main() -> int:
     best = None
     for M, K in grid:
         try:
-            row = run_one(args.problem, M, K)
+            row = run_one(args.problem, M, K, N=args.N,
+                          max_steps=max_steps)
         except Exception as e:  # noqa: BLE001 — keep sweeping
             row = {"problem": args.problem, "M": M, "K": K,
                    "error": f"{type(e).__name__}: {e}"}

@@ -52,18 +52,27 @@ else
 fi
 
 echo "== 7/8 chunk-size sweeps (un-measured configs first) =="
-# N-Queens was never chunk-tuned (bench extra sits at 0.28x ref C while
-# PFSP gained 1.3-3x from tuning); quick PFSP passes re-validate the
-# banked defaults against drift.
-timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
-TTS_COMPACT=sort timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
-TTS_COMPACT=search timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
+# N-Queens chunk sweep (first ever, VERDICT r5 #2): the default knob is
+# TTS_COMPACT=auto now (dense shift path for N-Queens); the scatter pin is
+# the round-5 baseline — together these rows ARE the fused-vs-scatter A/B
+# (docs/HW_VALIDATION.md armed-session rows; done bar: N=15 >= 10M
+# nodes/s). N=16/17 rows are bounded-dispatch rate rows (BASELINE
+# config 2).
+timeout 1800 python scripts/headline_tune.py --problem nqueens || true
+TTS_COMPACT=scatter timeout 1800 python scripts/headline_tune.py --problem nqueens --quick || true
+TTS_COMPACT=sort timeout 1200 python scripts/headline_tune.py --problem nqueens --quick || true
+TTS_COMPACT=search timeout 1200 python scripts/headline_tune.py --problem nqueens --quick || true
+timeout 1200 python scripts/headline_tune.py --problem nqueens --N 16 || true
+timeout 1200 python scripts/headline_tune.py --problem nqueens --N 17 --quick || true
+# Quick PFSP passes re-validate the banked defaults against drift; the
+# headline done bar is ta014 lb1 >= 4.3M nodes/s (beat the host C++ seq).
 timeout 1200 python scripts/headline_tune.py --quick || true
 timeout 1200 python scripts/lb2_tune.py --quick || true
-# Compaction A/B/C: the serialized-scatter hypothesis says sort- or
-# search-based compaction should beat the default scatter on TPU; these
-# passes quantify it on the same grid (rows are tagged with the mode;
-# bench also picks empirically per run).
+# Compaction A/B/C/D on the PFSP grid: auto (dense at M=1024 shapes) vs
+# the three explicit rank inversions (rows are tagged with the resolved
+# mode; bench also picks empirically per run and records the per-mode
+# evaluator-vs-maintenance cycle decomposition).
+TTS_COMPACT=scatter timeout 1200 python scripts/headline_tune.py --quick || true
 TTS_COMPACT=sort timeout 1200 python scripts/headline_tune.py --quick || true
 TTS_COMPACT=search timeout 1200 python scripts/headline_tune.py --quick || true
 # Cycle decomposition: where the non-evaluator ~85% of the cycle goes

@@ -91,6 +91,9 @@ CONFIGS: list[tuple[str, list[str], dict[str, str]]] = [
      {"TTS_LB2_STAGED": "0"}),
     ("ta014 lb1 M=1024 jnp", ["pfsp", "14", "lb1", "-", "1024"],
      {"TTS_PALLAS": "0"}),
+    # Default knob is TTS_COMPACT=auto now (survivor-path overhaul): the
+    # unpinned rows below warm the AUTO programs (dense at these shapes);
+    # the explicit compact=... variants warm the A/B counterparts.
     ("ta014 lb1 M=1024", ["pfsp", "14", "lb1", "-", "1024"], {}),
     ("ta014 lb1_d M=1024", ["pfsp", "14", "lb1_d", "-", "1024"], {}),
     ("nqueens N=15 M=65536", ["nqueens", "15", "65536"], {}),
@@ -99,20 +102,37 @@ CONFIGS: list[tuple[str, list[str], dict[str, str]]] = [
     # compile is shape-identical).
     ("nqueens N=16 M=65536", ["nqueens", "16", "65536"], {}),
     ("nqueens N=17 M=65536", ["nqueens", "17", "65536"], {}),
-    # Compaction-mode variants (ADVICE r5): bench's on-TPU A/B also
-    # dispatches TTS_COMPACT=sort and =search builds of the headline and
-    # lb2 programs (compact_mode is part of the routing token, so each is
-    # a distinct compile) — warm them too, or a fresh cache makes the pick
-    # burn its 600s/300s budget on compiles and skip modes. A green window
-    # banks all three compaction programs for both configs.
+    # First-ever N-Queens chunk-size sweep (VERDICT r5 #2,
+    # scripts/headline_tune.py --problem nqueens --N ...): bank the sweep
+    # grid's end points so the armed session spends its window measuring,
+    # not compiling (the 65536 rows above cover the middle).
+    ("nqueens N=15 M=8192", ["nqueens", "15", "8192"], {}),
+    ("nqueens N=15 M=262144", ["nqueens", "15", "262144"], {}),
+    ("nqueens N=16 M=262144", ["nqueens", "16", "262144"], {}),
+    ("nqueens N=17 M=131072", ["nqueens", "17", "131072"], {}),
+    # Compaction-mode variants (ADVICE r5 + the survivor-path A/B):
+    # bench's on-TPU pick dispatches every TTS_COMPACT mode (the mode is
+    # part of the routing token, so each is a distinct compile) — warm
+    # them too, or a fresh cache makes the pick burn its 600s/300s budget
+    # on compiles and skip modes. `scatter` must be pinned explicitly now
+    # that the default resolves to dense at these shapes.
+    ("ta014 lb1 M=1024 compact=scatter", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_COMPACT": "scatter"}),
     ("ta014 lb1 M=1024 compact=sort", ["pfsp", "14", "lb1", "-", "1024"],
      {"TTS_COMPACT": "sort"}),
     ("ta014 lb1 M=1024 compact=search", ["pfsp", "14", "lb1", "-", "1024"],
      {"TTS_COMPACT": "search"}),
+    ("ta014 lb2 M=1024 compact=scatter", ["pfsp", "14", "lb2", "-", "1024"],
+     {"TTS_COMPACT": "scatter"}),
     ("ta014 lb2 M=1024 compact=sort", ["pfsp", "14", "lb2", "-", "1024"],
      {"TTS_COMPACT": "sort"}),
     ("ta014 lb2 M=1024 compact=search", ["pfsp", "14", "lb2", "-", "1024"],
      {"TTS_COMPACT": "search"}),
+    # The N-Queens fused-vs-scatter A/B programs (docs/HW_VALIDATION.md
+    # armed-session rows): default auto resolves dense; scatter is the
+    # round-5 baseline path.
+    ("nqueens N=15 M=65536 compact=scatter", ["nqueens", "15", "65536"],
+     {"TTS_COMPACT": "scatter"}),
     # Large-instance classes (VERDICT r4 #7): ta031 = 50x10, ta056 = 50x20,
     # ta111 = 500x20. Kernel-level at the smoke-gate shapes (see _ITEM's
     # "kernel" note); the set mirrors test_large_instance_kernels_compile_on_tpu.

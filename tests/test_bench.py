@@ -188,15 +188,15 @@ def test_pick_compact_selection_rules(monkeypatch):
         calls.append(mode)
         if mode == "search":
             raise RuntimeError("compile boom")
-        nps = {"scatter": 10.0, "sort": 99.0}[mode]
+        nps = {"scatter": 10.0, "sort": 99.0, "dense": 7.0}[mode]
         return (object(), nps, 0.0, 0.0)
 
     stats, best = bench.pick_compact(run_fn, lambda r: r[1] < 50)
-    # sort is fastest but fails parity; scatter is the clean pick.
+    # sort is fastest but fails parity; scatter is the fastest clean pick.
     assert stats["picked"] == "scatter" and best[1] == 10.0
-    assert stats["parity"] == {"scatter": True, "sort": False}
+    assert stats["parity"] == {"scatter": True, "sort": False, "dense": True}
     assert "search" in stats["errors"]
-    assert calls == ["scatter", "sort", "search"]
+    assert calls == ["scatter", "sort", "search", "dense"]
 
     def run_fail():
         raise RuntimeError("no backend")
@@ -220,4 +220,30 @@ def test_pick_compact_budget_skips_but_always_runs_first(monkeypatch):
 
     stats, best = bench.pick_compact(run_fn, lambda r: True, budget_s=50.0)
     assert best is not None and stats["picked"] == "scatter"
-    assert stats["skipped_budget"] == ["sort", "search"]
+    assert stats["skipped_budget"] == ["sort", "search", "dense"]
+
+
+def test_pick_compact_records_decomposition_and_auto():
+    """The stats blob shows WHY a mode won: per-mode device ms/cycle, the
+    maintenance share against the evaluator-only calibration, and what the
+    auto policy would have resolved for the config."""
+
+    class _Diag:
+        kernel_launches = 10
+
+    class _Res:
+        diagnostics = _Diag()
+
+    def run_fn():
+        import os
+
+        nps = {"scatter": 10.0, "sort": 20.0, "search": 5.0, "dense": 8.0}
+        return (_Res(), nps[os.environ["TTS_COMPACT"]], 1.0, 0.5)
+
+    stats, best = bench.pick_compact(
+        run_fn, lambda r: True, eval_ms=20.0, auto_mode="dense"
+    )
+    assert stats["picked"] == "sort" and stats["auto"] == "dense"
+    d = stats["decomp"]["sort"]
+    # 0.5s device phase / 10 cycles = 50 ms/cycle; 20 of it evaluator.
+    assert d["cycle_ms"] == 50.0 and d["maint_ms"] == 30.0

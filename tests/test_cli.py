@@ -127,7 +127,9 @@ def test_compact_flag_pins_env_and_is_recorded(capsys, monkeypatch):
     cli.main(["nqueens", "--N", "8", "--tier", "device", "--M", "64",
               "--json"])
     rec2 = _last_json(capsys.readouterr().out)
-    assert rec2["compact"] == "scatter"  # default, not the prior run's pin
+    # Default knob is auto; the record carries the RESOLVED path (dense for
+    # N-Queens — ops/compaction.py policy), not the prior run's pin.
+    assert rec2["compact"] == "dense" and rec2["compact_auto"] is True
 
     # Offload/seq runs never compact: no flag, no key.
     with pytest.raises(SystemExit) as e:

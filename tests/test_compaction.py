@@ -1,0 +1,278 @@
+"""Survivor-path overhaul (`ops/compaction.py` + the fused prune+push in
+`engine/resident.py`): dense-path bit-exactness against the scatter oracle,
+the jaxpr pins the acceptance criteria demand (dense programs free of
+sort/scatter; at most ONE child-value-sized gather per cycle in every
+mode), the auto policy, and the push_rows telemetry."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine.resident import (
+    _compact_ids,
+    _make_program,
+    resident_search,
+    resolve_capacity,
+)
+from tpu_tree_search.engine.sequential import sequential_search
+from tpu_tree_search.ops import compaction
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard
+
+
+# -- dense ids vs the scatter oracle ---------------------------------------
+
+
+def _oracle_ids(keep, S):
+    """Host-side reference: survivors' flat ids in (parent, slot) order."""
+    flat = keep.reshape(-1)
+    return np.nonzero(flat)[0][:S], int(flat.sum())
+
+
+@pytest.mark.parametrize("shape,seed", [
+    ((64, 20), 3),      # the M=1k class (64 parents x 20 slots per case)
+    ((1024, 20), 7),    # M=1k headline shape
+    ((65536, 8), 11),   # M=64k grid — the N-Queens chunk class
+])
+def test_dense_ids_bitexact_vs_scatter_oracle(shape, seed):
+    rng = np.random.default_rng(seed)
+    densities = (0.0, 0.03, 0.5, 0.97, 1.0)
+    for p in densities:
+        keep = rng.random(shape) < p
+        S = keep.size if keep.size <= 20_000 else keep.size // 2
+        ids_d, inc_d = (np.asarray(x) for x in
+                        compaction.compact_ids(keep, S, "dense"))
+        ids_sc, inc_sc = (np.asarray(x) for x in
+                          compaction.compact_ids(keep, S, "scatter"))
+        ref, inc_ref = _oracle_ids(keep, S)
+        assert inc_d == inc_sc == inc_ref
+        k = min(inc_ref, S)
+        np.testing.assert_array_equal(ids_d[:k], ref[:k])
+        np.testing.assert_array_equal(ids_sc[:k], ref[:k])
+        # Dead rows stay in-bounds (the pool contract's only requirement).
+        assert (0 <= ids_d).all() and (ids_d < keep.size).all()
+
+
+def test_dense_ids_edge_masks():
+    for keep in (np.zeros((1, 7), bool), np.ones((5, 3), bool),
+                 np.eye(9, 9, dtype=bool)):
+        S = keep.size
+        ids_d, inc = (np.asarray(x) for x in
+                      compaction.compact_ids(keep, S, "dense"))
+        ref, inc_ref = _oracle_ids(keep, S)
+        assert inc == inc_ref
+        np.testing.assert_array_equal(ids_d[:inc], ref)
+
+
+# -- jaxpr pins -------------------------------------------------------------
+
+
+def _prim_names(jaxpr, out=None):
+    """Every primitive name in a (closed) jaxpr, recursing into sub-jaxprs
+    (while/cond/scan/pjit bodies)."""
+    if out is None:
+        out = []
+    for eqn in jaxpr.eqns:
+        out.append((eqn.primitive.name, eqn))
+        for v in eqn.params.values():
+            for sub in _as_jaxprs(v):
+                _prim_names(sub, out)
+    return out
+
+
+def _as_jaxprs(v):
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    if isinstance(v, Jaxpr):
+        return [v]
+    if isinstance(v, ClosedJaxpr):
+        return [v.jaxpr]
+    if isinstance(v, (list, tuple)):
+        return [j for x in v for j in _as_jaxprs(x)]
+    return []
+
+
+def _step_prims(problem, M, K=4, monkeypatch=None, mode=None):
+    import jax
+
+    if mode is not None:
+        monkeypatch.setenv("TTS_COMPACT", mode)
+    capacity, M = resolve_capacity(problem, M, None)
+    prog = _make_program(problem, 5, M, K, capacity, jax.devices()[0])
+    state = prog.init_state({}, getattr(problem, "initial_ub", 0))
+    jaxpr = jax.make_jaxpr(prog._step)(*state)
+    return prog, _prim_names(jaxpr.jaxpr)
+
+
+@pytest.mark.parametrize("mk", [
+    lambda: NQueensProblem(N=9),
+    lambda: PFSPProblem(lb="lb1", ub=0,
+                        p_times=taillard.reduced_instance(14, 10, 5)),
+])
+def test_dense_step_jaxpr_free_of_sort_scatter(mk, monkeypatch):
+    """The acceptance pin: under TTS_COMPACT=dense the WHOLE compiled step
+    — compaction, fused push, and the overflow fallback branch — contains
+    no sort, no scatter, and no searchsorted (searchsorted has no
+    primitive of its own; banning sort+scatter plus the compact_ids-level
+    gather pin below covers every implementation it could lower to)."""
+    _, prims = _step_prims(mk(), 128, monkeypatch=monkeypatch, mode="dense")
+    names = {n for n, _ in prims}
+    assert not any(n.startswith("scatter") for n in names), names
+    assert "sort" not in names, names
+
+
+def test_dense_compact_ids_jaxpr_gather_free(monkeypatch):
+    """The dense rank inversion itself is pure shifts + selects: no sort,
+    no scatter, and not even a gather (the fused write performs the
+    cycle's single gather)."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(
+        lambda k: compaction.compact_ids(k, 640, "dense")
+    )(np.zeros((64, 20), bool))
+    names = {n for n, _ in _prim_names(jaxpr.jaxpr)}
+    for banned in ("sort", "gather"):
+        assert banned not in names, names
+    assert not any(n.startswith("scatter") for n in names), names
+
+
+@pytest.mark.parametrize("mode", ["scatter", "sort", "search", "dense"])
+def test_fused_push_single_child_value_gather(mode, monkeypatch):
+    """Op-count pin for the fused prune+push: in EVERY mode the compiled
+    step contains at most one gather big enough to be moving child values
+    (>= S rows of n lanes) — the single augmented (row, aux) gather of the
+    fused write.  The pre-fusion body gathered rows, both swap lanes, and
+    aux separately."""
+    prob = PFSPProblem(lb="lb1", ub=0,
+                       p_times=taillard.reduced_instance(14, 10, 5))
+    prog, prims = _step_prims(prob, 128, monkeypatch=monkeypatch, mode=mode)
+    n = prob.child_slots
+    vals_dt = np.dtype(prog.pool_fields[0][1])
+    # "Child values" = pool-value-dtype rows; the search mode additionally
+    # gathers (S, n) keep/lane MASKS by design, which move no node data.
+    big = [
+        eqn for name, eqn in prims
+        if name == "gather"
+        and any(v.aval.size >= prog.S * n and v.aval.dtype == vals_dt
+                for v in eqn.outvars)
+    ]
+    assert len(big) <= 1, (mode, [str(e) for e in big])
+
+
+def test_auto_resolves_identically_to_explicit(monkeypatch):
+    """TTS_COMPACT=auto must bake in the same program as the explicitly
+    spelled mode it resolves to — byte-identical jaxpr, so the policy
+    layer adds zero behavior of its own."""
+    import jax
+
+    def jaxpr_text(mode):
+        monkeypatch.setenv("TTS_COMPACT", mode)
+        prob = NQueensProblem(N=8)  # fresh instance: no cached programs
+        capacity, M = resolve_capacity(prob, 64, None)
+        prog = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
+        assert prog.compact == "dense"  # the policy pick for N-Queens
+        state = prog.init_state({}, 0)
+        return str(jax.make_jaxpr(prog._step)(*state))
+
+    assert jaxpr_text("auto") == jaxpr_text("dense")
+
+
+# -- auto policy ------------------------------------------------------------
+
+
+def test_auto_policy_table(monkeypatch):
+    monkeypatch.setenv("TTS_COMPACT", "auto")
+    nq = NQueensProblem(N=10)
+    pf1 = PFSPProblem(inst=14, lb="lb1", ub=1)  # pruned regime (opt UB)
+    pf0 = PFSPProblem(lb="lb1", ub=0,           # no-prune regime (inf UB)
+                      p_times=taillard.reduced_instance(14, 10, 5))
+    # N-Queens: dense on every backend (no pruning — dense survivors).
+    assert compaction.resolve_compact_mode(nq, 65536, 10) == "dense"
+    # Non-TPU backends keep the measured CPU default for PFSP.
+    assert compaction._auto_compact(pf1, 1024, 20, "cpu") == "scatter"
+    # TPU: small grids and the no-prune (ub=inf) regime go dense; large
+    # pruned grids take the binary-search inverse.
+    assert compaction._auto_compact(pf1, 1024, 20, "tpu") == "dense"
+    assert compaction._auto_compact(pf1, 65536, 20, "tpu") == "search"
+    assert compaction._auto_compact(pf0, 65536, 20, "tpu") == "dense"
+    # An explicit knob always wins over the policy.
+    monkeypatch.setenv("TTS_COMPACT", "sort")
+    assert compaction.resolve_compact_mode(nq, 65536, 10) == "sort"
+    # Bad knob values fail loudly.
+    monkeypatch.setenv("TTS_COMPACT", "bogus")
+    with pytest.raises(ValueError):
+        compaction.compact_mode()
+
+
+def test_auto_knob_flip_rebuilds_program_same_instance(monkeypatch):
+    """auto <-> explicit flips between searches on ONE problem instance
+    must rebuild the resident program (the raw knob is part of the routing
+    token), and both runs must land identical counts."""
+    prob = NQueensProblem(N=9)
+    seq = sequential_search(prob)
+    monkeypatch.setenv("TTS_COMPACT", "auto")
+    r1 = resident_search(prob, m=8, M=128, K=32)
+    n_after = len(prob._resident_programs)
+    monkeypatch.setenv("TTS_COMPACT", "search")
+    r2 = resident_search(prob, m=8, M=128, K=32)
+    assert len(prob._resident_programs) == n_after + 1
+    assert r1.compact == "dense" and r1.compact_auto
+    assert r2.compact == "search" and not r2.compact_auto
+    for r in (r1, r2):
+        assert (r.explored_tree, r.explored_sol) == (
+            seq.explored_tree, seq.explored_sol)
+
+
+# -- end-to-end dense parity (both problems, overflow branch included) ------
+
+
+def test_dense_end_to_end_parity_both_problems(monkeypatch):
+    monkeypatch.setenv("TTS_COMPACT", "dense")
+    prob = NQueensProblem(N=10)
+    seq = sequential_search(prob)
+    res = resident_search(NQueensProblem(N=10), m=8, M=1024, K=64)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol)
+    assert res.compact == "dense" and not res.compact_auto
+
+    ptm = taillard.reduced_instance(14, jobs=10, machines=5)
+    opt = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm)).best
+    seqp = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm),
+                             initial_best=opt)
+    resp = resident_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm),
+                           m=8, M=1024, K=64, initial_best=opt)
+    assert (resp.explored_tree, resp.explored_sol, resp.best) == (
+        seqp.explored_tree, seqp.explored_sol, opt)
+
+
+def test_dense_overflow_branch_parity(monkeypatch):
+    """Force the dense overflow path (survivors > S): shallow N-Queens
+    chunks keep ~M*(N-d) children >> S = M*N/2 — the shift-compacted
+    full-row write must land the sequential goldens exactly, scatter-free."""
+    monkeypatch.setenv("TTS_COMPACT", "dense")
+    prob = NQueensProblem(N=11)
+    seq = sequential_search(prob)
+    res = resident_search(NQueensProblem(N=11), m=8, M=512, K=8)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol)
+
+
+# -- telemetry: the maintenance/evaluator split -----------------------------
+
+
+def test_push_rows_counter_and_report_split(monkeypatch):
+    from tpu_tree_search.obs import capture, report
+
+    monkeypatch.setenv("TTS_COMPACT", "dense")
+    monkeypatch.setenv("TTS_OBS", "1")
+    with capture() as cap:
+        res = resident_search(NQueensProblem(N=9), m=5, M=128)
+    c = res.obs["device_counters"]
+    # The fused path processes its full S budget per cycle: push_rows is
+    # the maintenance-work series and can never undercount the survivors.
+    assert c["push_rows"] >= c["pushed"] > 0
+    s = report.summarize(cap.events)["survivor_path"]
+    assert s["push_rows"] == c["push_rows"]
+    assert s["eval_rows"] == c["pushed"] + c["leaves"] + c["pruned"]
+    assert s["push_rows_per_survivor"] >= 1.0

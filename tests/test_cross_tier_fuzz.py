@@ -104,6 +104,17 @@ def test_all_tiers_match_sequential_pairblocked_lb2(seed, pairblock, staged,
     _fuzz_all_tiers(seed, "lb2")
 
 
+@pytest.mark.parametrize("mode", ["dense", "auto"])
+def test_all_tiers_match_sequential_compact_axis(mode, monkeypatch):
+    """Compaction-path axis (survivor-path overhaul): every tier — the
+    fused prune+push runs shard-local inside mesh/dist_mesh via the shared
+    loop body — must land the sequential counts under the dense shift path
+    and under the auto policy.  The sort/search modes ride CI's dedicated
+    per-mode tier-1 jobs (.github/workflows/ci.yml tests-compact)."""
+    monkeypatch.setenv("TTS_COMPACT", mode)
+    _fuzz_all_tiers(167, "lb1")
+
+
 def _random_instance(seed: int, jobs: int, machines: int):
     rng = np.random.default_rng(seed)
     return np.ascontiguousarray(

@@ -28,7 +28,7 @@ def test_nqueens_parity():
     )
 
 
-@pytest.mark.parametrize("mode", ["scatter", "sort", "search"])
+@pytest.mark.parametrize("mode", ["scatter", "sort", "search", "dense"])
 def test_nqueens_overflow_fallback(mode, monkeypatch):
     # A warm frontier beyond the fan-out headroom forces the capacity-stall
     # path (host offload cycles until the pool fits again), and M=256 makes
@@ -202,7 +202,7 @@ def test_compact_ids_sort_matches_scatter(monkeypatch):
         S = keep.size  # full budget: exercises every survivor position
         monkeypatch.setenv("TTS_COMPACT", "scatter")
         ids_sc, inc_sc = (np.asarray(x) for x in _compact_ids(keep, S))
-        for mode in ("sort", "search"):
+        for mode in ("sort", "search", "dense"):
             monkeypatch.setenv("TTS_COMPACT", mode)
             ids_x, inc_x = (np.asarray(x) for x in _compact_ids(keep, S))
             assert inc_sc == inc_x == keep.sum(), mode
@@ -216,14 +216,15 @@ def test_compact_knob_parity_end_to_end(monkeypatch):
     ptm = taillard.reduced_instance(14, jobs=9, machines=5)
     opt = sequential_search(PFSPProblem(lb="lb1", ub=0, p_times=ptm)).best
     results = {}
-    for mode in ("scatter", "sort", "search"):
+    for mode in ("scatter", "sort", "search", "dense"):
         monkeypatch.setenv("TTS_COMPACT", mode)
         res = resident_search(
             PFSPProblem(lb="lb1", ub=0, p_times=ptm), m=8, M=128, K=32,
             initial_best=opt,
         )
         results[mode] = (res.explored_tree, res.explored_sol, res.best)
-    assert results["scatter"] == results["sort"] == results["search"]
+    assert (results["scatter"] == results["sort"] == results["search"]
+            == results["dense"])
 
 
 def test_compact_knob_flip_rebuilds_program_same_instance(monkeypatch):

@@ -254,12 +254,13 @@ def test_large_instance_kernels_compile_on_tpu(inst, lb, B):
     np.testing.assert_array_equal(got[open_], ref[open_])
 
 
-@pytest.mark.parametrize("mode", ["scatter", "sort", "search"])
+@pytest.mark.parametrize("mode", ["scatter", "sort", "search", "dense"])
 def test_compact_modes_on_tpu(mode, monkeypatch):
-    """All three TTS_COMPACT rank inversions through the real XLA:TPU
-    lowering (sort/search are plain XLA ops — no Mosaic — but their TPU
-    lowerings must produce the same exact counts the CPU suite pins; the
-    scatter row doubles as the serialized-scatter baseline)."""
+    """All four TTS_COMPACT rank inversions through the real XLA:TPU
+    lowering (sort/search/dense are plain XLA ops — no Mosaic — but their
+    TPU lowerings must produce the same exact counts the CPU suite pins;
+    the scatter row doubles as the serialized-scatter baseline and the
+    dense row proves the shift-compaction fast path on chip)."""
     from tpu_tree_search.engine.resident import resident_search
     from tpu_tree_search.engine.sequential import sequential_search
     from tpu_tree_search.problems import PFSPProblem

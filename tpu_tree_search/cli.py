@@ -67,10 +67,15 @@ def build_parser() -> argparse.ArgumentParser:
         "loop over a second mesh axis of this size (dp x mp devices)",
     )
     common.add_argument(
-        "--compact", choices=["scatter", "sort", "search"], default=None,
-        help="stream-compaction implementation for the device tiers "
-        "(default: TTS_COMPACT env or 'scatter'; the three are "
-        "bit-identical — pick by measurement, see bench.py's per-run A/B)",
+        "--compact",
+        choices=["auto", "scatter", "sort", "search", "dense"],
+        default=None,
+        help="survivor-path compaction for the device tiers "
+        "(default: TTS_COMPACT env or 'auto' — picks per problem shape "
+        "from the measured table in ops/compaction.py; the explicit "
+        "modes are bit-identical — pick by measurement, see bench.py's "
+        "per-run A/B; 'dense' is the shift-based fast path, free of "
+        "sort/scatter/searchsorted)",
     )
     common.add_argument("--stats-file", type=str, default=None,
                         help="append one result line to this .dat file")
@@ -464,6 +469,13 @@ def print_settings(args) -> None:
         if args.lb == "lb2" and args.lb2_variant != "full":
             print(f"lb2 machine-pair subset: {args.lb2_variant}")
         print("Branching rule: fwd")
+    if uses_compaction(args):
+        # The raw knob; the RESOLVED path (auto picks per problem shape)
+        # is printed with the results and recorded in the stats line.
+        import os
+
+        knob = args.compact or os.environ.get("TTS_COMPACT", "auto")
+        print(f"Survivor path (TTS_COMPACT): {knob}")
     print("=================================================")
 
 
@@ -495,6 +507,9 @@ def print_results(args, problem, res) -> None:
     if res.per_worker_tree:
         shares = ", ".join(f"{s:.2f}" for s in res.workload_shares())
         print(f"Workload per device (%): [{shares}]")
+    if res.compact:
+        tag = " (auto)" if res.compact_auto else ""
+        print(f"Survivor path: {res.compact}{tag}")
     d = res.diagnostics
     if d.kernel_launches:
         print(
@@ -548,13 +563,20 @@ def result_record(args, res) -> dict:
 
         rec["pallas"] = PK.use_pallas()
         if uses_compaction(args):
-            # args.compact first: run_tier restores the env pin before this
-            # record is built. Runs whose engine never compacts carry no
-            # "compact" key at all — a stats line must not claim a mode the
-            # run did not use.
+            # The RESOLVED survivor path the compiled step baked in (the
+            # engine surfaces it on the result — under auto the knob alone
+            # no longer names the mode). Runs whose engine never compacts
+            # carry no "compact" key at all — a stats line must not claim
+            # a mode the run did not use.  Fallback for engines that do
+            # compact but predate the surfacing: args.compact first
+            # (run_tier restores the env pin before this record is built).
             from .ops.pfsp_device import compact_mode
 
-            rec["compact"] = args.compact or compact_mode()
+            rec["compact"] = (
+                res.compact or args.compact or compact_mode()
+            )
+            if res.compact_auto:
+                rec["compact_auto"] = True
         if args.problem == "pfsp" and args.lb == "lb2":
             # Staging applies at every mp: under mp > 1 the compacted self
             # bound shards its pair loop with a pmax combine. The job count
@@ -585,9 +607,16 @@ def result_record(args, res) -> dict:
 
 
 def enable_compile_cache() -> None:
-    """Persist XLA executables across processes (the resident tiers compile
-    ~30s while-loop programs; the cache makes repeat CLI/bench runs start in
-    seconds). Opt out with TTS_COMPILE_CACHE=0 or point it at a directory."""
+    """Persist XLA/Mosaic executables across processes (the resident tiers
+    compile ~30s while-loop programs, and large-instance Mosaic compiles
+    exceed 240s — ta056/ta111 class, docs/HW_VALIDATION.md).
+
+    ``TTS_COMPILE_CACHE=<dir>`` points the cache at a shared directory (the
+    warm-cache recipe: run ``scripts/warm_cache.py`` once during any green
+    window with the same value, and every later CLI/bench/sweep process —
+    they all call this at startup — reuses the banked executables);
+    ``TTS_COMPILE_CACHE=0`` opts out; unset defaults to a per-build
+    ``~/.cache/tpu_tree_search/xla/<key>`` directory."""
     import os
 
     want = os.environ.get("TTS_COMPILE_CACHE", "")

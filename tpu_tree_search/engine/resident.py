@@ -23,10 +23,14 @@ Appendix A):
     children are always pushed (no pruning), depth-N leaves included
     (`nqueens_chpl.chpl:70-89`).
 
-The push is a masked scatter: survivors are ranked with a prefix sum and
-scattered to `pool[size + rank]` (out-of-bounds destinations dropped), the
-device-side equivalent of the prune+compact improvement suggested in
-SURVEY.md §7.3 ("move prune+compact onto device").
+The push is a fused prune+push (the device-side equivalent of the
+prune+compact improvement suggested in SURVEY.md §7.3): survivors are
+ranked with hierarchical prefix sums, the rank map is inverted by the
+compaction mode baked in at build time (`ops/compaction.py` — scatter /
+sort / binary-search / shift-based dense, `TTS_COMPACT=auto` picks per
+problem shape), and each surviving child row is rebuilt *at its
+destination slot* from one gather of its parent's (row, aux) — the
+(M, n, n) child cube is materialized only on the rare overflow fallback.
 
 Capacity safety: the loop only runs a cycle while `size + M*child_slots <=
 capacity`, so a cycle can never lose children.  If the pool outgrows that
@@ -83,65 +87,17 @@ def _swap_children(chunk_vals, depth):
     return jnp.where(iota == d, val_at_k, jnp.where(iota == kcol, val_at_d, base))
 
 
-def _compact_ids(keep, S: int):
-    """Stream-compaction indices of the surviving (parent, slot) pairs.
+def _compact_ids(keep, S: int, mode: str | None = None):
+    """Stream-compaction ids of the surviving (parent, slot) pairs — the
+    engine-side entry point for `ops/compaction.compact_ids` (which owns
+    the four rank inversions and their contract).  ``mode=None`` resolves
+    the ``TTS_COMPACT`` knob without problem context (bare/oracle calls in
+    tests); the resident programs pass their baked-in resolved mode."""
+    from ..ops.compaction import compact_ids, resolve_compact_mode
 
-    keep: (M, n) bool. Returns (ids, tree_inc): ids (S,) int32 such that
-    ids[s] = flat index i*n+k of the s-th survivor in (parent, slot) order
-    for s < tree_inc (the reference's child push order,
-    `pfsp_gpu_chpl.chpl:276-298`). Ranks are computed hierarchically (lane
-    scan + per-parent prefix) — much cheaper than a flat M*n cumsum. The
-    rank inversion is selected by ``compact_mode``: a stable argsort of
-    ranked keys (survivors carry their unique rank, non-survivors the max
-    key, so sorted position s holds exactly the rank-s survivor), a
-    binary-search inverse (parent via searchsorted into the prefix, slot
-    via the lane cumsum), or one int32-id scatter."""
-    import jax.numpy as jnp
-
-    from ..ops.pfsp_device import compact_mode
-
-    M, n = keep.shape
-    cnt = jnp.sum(keep, axis=1, dtype=jnp.int32)  # (M,)
-    offs = jnp.cumsum(cnt) - cnt  # exclusive prefix
-    lane = jnp.cumsum(keep.astype(jnp.int32), axis=1) - keep
-    ranks = offs[:, None] + lane  # (M, n)
-    tree_inc = offs[-1] + cnt[-1]
-    Mn = M * n
-    flat = keep.reshape(Mn)
-    mode = compact_mode()
-    if mode == "sort":
-        key = jnp.where(flat, ranks.reshape(Mn), jnp.int32(Mn))
-        ids = jnp.argsort(key, stable=True)[:S].astype(jnp.int32)
-        return ids, tree_inc
-    if mode == "search":
-        # Binary-search inverse: for output rank s, its parent is the last
-        # p with offs[p] <= s (zero-count parents share the next parent's
-        # offs, so side='right' skips them), and its slot is the lane
-        # whose exclusive cumsum equals the within-parent rank. log2(M)
-        # vectorized gather rounds + one (S, n) lane pass — no scatter, no
-        # sort; rows past tree_inc resolve arbitrarily (dead by the pool
-        # contract) but stay in-bounds via the clips.
-        pos = jnp.arange(S, dtype=jnp.int32)
-        parent = jnp.clip(
-            jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1,
-            0, M - 1,
-        )
-        r = pos - offs[parent]  # within-parent rank
-        krows = keep[parent]  # (S, n)
-        lane_s = lane[parent]  # (S, n) exclusive lane cumsum
-        slot = jnp.argmax((lane_s == r[:, None]) & krows, axis=1)
-        ids = (parent * n + slot).astype(jnp.int32)
-        return ids, tree_inc
-    flat_idx = jnp.arange(Mn, dtype=jnp.int32)
-    # Non-survivors get distinct out-of-bounds destinations so the scatter
-    # is genuinely unique-indexed (mode="drop" discards them).
-    dst = jnp.where(flat, ranks.reshape(Mn), S + flat_idx)
-    ids = (
-        jnp.zeros((S,), jnp.int32)
-        .at[dst]
-        .set(flat_idx, mode="drop", unique_indices=True)
-    )
-    return ids, tree_inc
+    if mode is None:
+        mode = resolve_compact_mode()
+    return compact_ids(keep, S, mode)
 
 
 class _ResidentProgram:
@@ -174,6 +130,19 @@ class _ResidentProgram:
         # int32 counters.
         self.K = max(1, min(K, (2**31 - 1) // max(1, M * n)))
         self.device = device if device is not None else jax.devices()[0]
+        # Survivor-path selection (ops/compaction.py): resolved once at
+        # build time from the TTS_COMPACT knob / auto policy and baked into
+        # the compiled step.  Surfaced through SearchResult.compact so a
+        # stats line can prove which path ran.
+        from ..ops.compaction import compact_mode, resolve_compact_mode
+
+        self.compact = resolve_compact_mode(problem, M, n, self.device)
+        self.compact_auto = compact_mode() == "auto"
+        # The while condition reserves exactly M*n rows of headroom, so the
+        # survivor budget must never exceed it (a small M would otherwise
+        # make the fused-path write overrun the reservation and corrupt
+        # live rows).
+        self.S = min(max(64 * n, M * n // self.survivor_budget_div), M * n)
         # On-device cycle counters (TTS_OBS=1, obs/counters.py): baked in at
         # build time — when off, the carry/body/jaxpr are byte-identical to
         # a counter-free build (compiled out, not branched). _make_program
@@ -192,15 +161,15 @@ class _ResidentProgram:
         import jax.numpy as jnp
         from jax import lax
 
+        from ..ops.compaction import shift_compact, survivor_ranks
+
         n = self.problem.child_slots
         m, M, C = self.m, self.M, self.capacity
         K = self.K if K is None else K
         Mn = M * n
         obs = self.obs
-        # The while condition reserves exactly Mn rows of headroom, so the
-        # budget must never exceed Mn (a small M would otherwise make the
-        # small-path write overrun the reservation and corrupt live rows).
-        S = min(max(64 * n, Mn // self.survivor_budget_div), Mn)
+        S = self.S
+        mode = self.compact
         vals_dt = self.pool_fields[0][1]
         aux_dt = self.pool_fields[1][1]
         evaluate = self._make_eval()
@@ -225,44 +194,82 @@ class _ResidentProgram:
             keep, sol_inc, best = evaluate(vals_c, aux_c, valid, best)
             d = swap_of(aux_c)  # (M,) swap position per parent
 
-            ids, tree_inc = _compact_ids(keep, S)
+            ids, tree_inc = _compact_ids(keep, S, mode)
             fits = tree_inc <= S
 
             def small(pool_vals, pool_aux):
-                # Gather only the survivor budget; rows beyond tree_inc are
-                # garbage past the new size (dead by the pool contract).
+                # Fused prune+push: ONE gather of the survivor budget —
+                # parent row and parent aux ride the same augmented
+                # (M, n+1) gather (aux fits the pool value dtype: limit1
+                # in [-1, n) and depth in [0, N] are in range) — and the
+                # child row is rebuilt at its destination slot by pure
+                # selects over the gathered row (the `_swap_children`
+                # structure: a child differs from its parent at exactly
+                # the two swapped positions), so the (M, n, n) child cube
+                # is never materialized and never gathered twice.  Rows
+                # beyond tree_inc are garbage past the new size (dead by
+                # the pool contract).
                 pi = ids // n
                 kj = ids % n
-                rows = vals8_c[pi]  # (S, n) narrow-dtype gather
-                dp = d[pi]
+                aug = jnp.concatenate(
+                    [vals8_c, aux_c.astype(vals_dt)[:, None]], axis=1
+                )
+                g = aug[pi]  # (S, n+1): the cycle's one child-value gather
+                rows = g[:, :n]
+                pa = g[:, n].astype(jnp.int32)  # parent aux
+                dp = swap_of(pa)
                 iota = jnp.arange(n, dtype=jnp.int32)[None, :]
-                v_k = jnp.take_along_axis(rows, kj[:, None], axis=1)
-                v_d = jnp.take_along_axis(rows, dp[:, None], axis=1)
+                ohd = iota == dp[:, None]
+                ohk = iota == kj[:, None]
+                # One-hot extraction instead of take_along_axis: exactly
+                # one lane is selected per row, so the sum is exact.
+                v_k = jnp.sum(jnp.where(ohk, rows, 0), axis=1,
+                              dtype=jnp.int32)
+                v_d = jnp.sum(jnp.where(ohd, rows, 0), axis=1,
+                              dtype=jnp.int32)
                 crows = jnp.where(
-                    iota == dp[:, None],
-                    v_k,
-                    jnp.where(iota == kj[:, None], v_d, rows),
+                    ohd,
+                    v_k[:, None].astype(vals_dt),
+                    jnp.where(ohk, v_d[:, None].astype(vals_dt), rows),
                 )
                 pool_vals = lax.dynamic_update_slice(
                     pool_vals, crows, (size, jnp.int32(0))
                 )
                 pool_aux = lax.dynamic_update_slice(
-                    pool_aux, (aux_c[pi] + 1).astype(aux_dt), (size,)
+                    pool_aux, (pa + 1).astype(aux_dt), (size,)
                 )
                 return pool_vals, pool_aux
 
             def big(pool_vals, pool_aux):
-                # Overflow fallback: full masked row scatter (rare — only
-                # when a chunk keeps more than S children).
+                # Overflow fallback (rare — only when a chunk keeps more
+                # than S children): materialize the child cube and place
+                # all survivors at once.
                 child = _swap_children(vals_c, d).astype(vals_dt)
-                lane = jnp.cumsum(keep.astype(jnp.int32), axis=1) - keep
-                cntp = jnp.sum(keep, axis=1, dtype=jnp.int32)
-                ranks = (jnp.cumsum(cntp) - cntp)[:, None] + lane
+                ranks, _ = survivor_ranks(keep)
+                caux = jnp.repeat(aux_c + 1, n).astype(aux_dt)
+                if mode == "dense":
+                    # Scatter-free overflow: shift-compact the child rows
+                    # themselves (ops/compaction.py), then one contiguous
+                    # write of the reserved Mn headroom — rows past
+                    # tree_inc are dead by the pool contract.
+                    flat_idx = jnp.arange(Mn, dtype=jnp.int32)
+                    dist = jnp.where(
+                        keep.reshape(Mn), flat_idx - ranks.reshape(Mn), 0
+                    )
+                    rowsc, auxc = shift_compact(
+                        dist, (child.reshape(Mn, n), caux)
+                    )
+                    pool_vals = lax.dynamic_update_slice(
+                        pool_vals, rowsc, (size, jnp.int32(0))
+                    )
+                    pool_aux = lax.dynamic_update_slice(
+                        pool_aux, auxc, (size,)
+                    )
+                    return pool_vals, pool_aux
                 dest = jnp.where(keep.reshape(Mn), size + ranks.reshape(Mn), C)
                 pool_vals = pool_vals.at[dest].set(
                     child.reshape(Mn, n), mode="drop"
                 )
-                caux = jnp.repeat(aux_c + 1, n).astype(aux_dt)
                 pool_aux = pool_aux.at[dest].set(caux, mode="drop")
                 return pool_vals, pool_aux
 
@@ -273,8 +280,13 @@ class _ResidentProgram:
                 tree + tree_inc, sol + sol_inc, cycles + 1,
             )
             if obs:
+                # push_rows: rows the push stage processed this cycle —
+                # the maintenance-work series (the fused path always
+                # touches its full S budget; the overflow path the whole
+                # Mn reservation), vs the evaluator's cnt*n child evals.
+                push_rows = jnp.where(fits, jnp.int32(S), jnp.int32(Mn))
                 ctr = obs_counters.update(
-                    ctr, cnt, n, tree_inc, sol_inc, fits, size
+                    ctr, cnt, n, tree_inc, sol_inc, fits, size, push_rows
                 )
                 return out + (ctr,)
             return out
@@ -728,6 +740,8 @@ def resident_search(
                 phases=phases,
                 diagnostics=diagnostics,
                 complete=False,
+                compact=program.compact,
+                compact_auto=program.compact_auto,
                 obs=obs_result(),
             )
         if cycles == 0:
@@ -793,5 +807,7 @@ def resident_search(
         elapsed=t3 - t0,
         phases=phases,
         diagnostics=diagnostics,
+        compact=program.compact,
+        compact_auto=program.compact_auto,
         obs=obs_result(),
     )

@@ -51,6 +51,12 @@ class SearchResult:
     # dist tier: inter-host communicator totals (exchange rounds, stolen
     # blocks/nodes), summed across hosts.
     comm: dict | None = None
+    # Resident tiers: the survivor-path compaction mode the compiled step
+    # baked in (ops/compaction.py — "dense"/"scatter"/"sort"/"search"),
+    # with compact_auto True when the TTS_COMPACT=auto policy chose it.
+    # None for tiers that prune on host and never compact.
+    compact: str | None = None
+    compact_auto: bool = False
     # Telemetry snapshot (TTS_OBS=1, docs/OBSERVABILITY.md): per-run totals
     # of the on-device counter block harvested at dispatch boundaries
     # ({"device_counters": {popped, pushed, leaves, pruned, overflow,

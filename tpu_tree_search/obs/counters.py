@@ -27,10 +27,19 @@ Slot semantics (``SLOTS`` order; all int32, reset each dispatch):
                       structurally-closed slots of deep PFSP parents — the
                       bound-cut vs closed split is not observable from the
                       body without re-deriving the evaluator's masks);
-  * ``overflow``    — cycles that took the full-scatter fallback (survivors
+  * ``overflow``    — cycles that took the overflow fallback (survivors
                       exceeded the compaction budget S);
   * ``pool_hwm``    — high-water mark of the pool size after the push;
-  * ``surv_hwm``    — high-water mark of per-cycle survivors (``tree_inc``).
+  * ``surv_hwm``    — high-water mark of per-cycle survivors (``tree_inc``);
+  * ``push_rows``   — rows the survivor-path push stage processed (the
+                      fused path touches its full S budget per cycle, the
+                      overflow path the whole M*n reservation).  Together
+                      with the evaluator's child-eval count
+                      (``pushed + leaves + pruned``) this is the
+                      maintenance-vs-evaluator WORK split `tts report`
+                      prints — a device-side clock does not exist, so the
+                      time split is measured at dispatch level by
+                      ``bench.py``'s eval-only-loop calibration instead.
 
 Counter headroom rides the engines' existing K clamp (``K*M*n < 2^31`` per
 dispatch); the host accumulates across dispatches in Python ints.
@@ -48,6 +57,7 @@ SLOTS = (
     "overflow",
     "pool_hwm",
     "surv_hwm",
+    "push_rows",
 )
 NSLOTS = len(SLOTS)
 
@@ -72,11 +82,11 @@ def init_block():
 
 
 # tts-lint: traced (called from the resident while-loop body when TTS_OBS=1)
-def update(ctr, cnt, n: int, tree_inc, sol_inc, fits, size):
+def update(ctr, cnt, n: int, tree_inc, sol_inc, fits, size, push_rows):
     """One cycle's accumulation: pure elementwise jnp on a (NSLOTS,) int32
-    vector. ``cnt``/``tree_inc``/``sol_inc``/``size`` are traced scalars
-    from the loop body, ``fits`` the small-path predicate, ``n`` the static
-    child-slot count."""
+    vector. ``cnt``/``tree_inc``/``sol_inc``/``size``/``push_rows`` are
+    traced scalars from the loop body, ``fits`` the fused-path predicate,
+    ``n`` the static child-slot count."""
     import jax.numpy as jnp
 
     inc = jnp.stack([
@@ -87,10 +97,11 @@ def update(ctr, cnt, n: int, tree_inc, sol_inc, fits, size):
         jnp.where(fits, 0, 1).astype(jnp.int32),
         jnp.int32(0),
         jnp.int32(0),
+        push_rows,
     ])
     hwm = jnp.stack([
         jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
-        jnp.int32(0), size, tree_inc,
+        jnp.int32(0), size, tree_inc, jnp.int32(0),
     ])
     return jnp.maximum(ctr + inc, hwm)
 

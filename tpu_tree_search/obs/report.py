@@ -116,6 +116,30 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
                 else:
                     counters_total[k] = counters_total.get(k, 0) + v
 
+    # -- survivor-path work split (maintenance vs evaluator) ---------------
+    # The resident cycle does two kinds of work: the evaluator bounds every
+    # candidate child (pushed + leaves + pruned evaluations), and the
+    # survivor path pops/compacts/pushes rows (push_rows — the fused path
+    # touches its full budget per cycle regardless of how many children
+    # survived).  A device-side clock does not exist, so this is the WORK
+    # split; bench.py's eval-only-loop calibration provides the measured
+    # time split per compaction mode.
+    survivor = None
+    if counters_total.get("push_rows"):
+        evals = (counters_total.get("pushed", 0)
+                 + counters_total.get("leaves", 0)
+                 + counters_total.get("pruned", 0))
+        pushed = counters_total.get("pushed", 0)
+        survivor = {
+            "eval_rows": evals,
+            "push_rows": counters_total["push_rows"],
+            "push_rows_per_survivor": (
+                round(counters_total["push_rows"] / pushed, 2) if pushed
+                else None
+            ),
+            "overflow_cycles": counters_total.get("overflow", 0),
+        }
+
     return {
         "events": len(evts),
         "span_s": round(span_s, 6),
@@ -124,6 +148,7 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
         "idle": idle,
         "cycle_rate": timeline,
         "device_counters": counters_total,
+        "survivor_path": survivor,
     }
 
 
@@ -172,6 +197,15 @@ def render(summary: dict) -> str:
         out.append(
             "device counters: "
             + "  ".join(f"{k}={v}" for k, v in sorted(c.items()))
+        )
+    if summary.get("survivor_path"):
+        sp = summary["survivor_path"]
+        out.append(
+            f"survivor path: {sp['eval_rows']} child evals vs "
+            f"{sp['push_rows']} push rows"
+            + (f" ({sp['push_rows_per_survivor']} rows/survivor)"
+               if sp["push_rows_per_survivor"] is not None else "")
+            + f", {sp['overflow_cycles']} overflow cycle(s)"
         )
     return "\n".join(out)
 

@@ -1,0 +1,232 @@
+"""Survivor-path stream compaction for the resident engines.
+
+One chunk cycle ends by pushing the surviving children contiguously onto
+the pool in (parent, slot) order — the reference's child push order
+(`pfsp_gpu_chpl.chpl:276-298`).  Computing each survivor's *rank* is a pair
+of prefix sums (cheap); inverting the rank map (which (parent, slot) is
+the rank-s survivor?) is the expensive part, and round-5 hardware numbers
+put it — not bound evaluation — at ~85% of resident-cycle time (VERDICT r5
+"What's weak" #1-3).  This module owns every rank-inversion implementation
+and the policy that picks between them:
+
+  * ``scatter`` — one int32-id scatter to unique destinations.  Fast on
+    CPU (gather-like); XLA:TPU lowers large general scatters to a
+    mostly-serial loop.
+  * ``sort``    — stable argsort of ranked keys (TPU's vectorized sort).
+  * ``search``  — binary-search inverse: log2(M) gather rounds plus one
+    (S, n) lane pass.  No sort, no scatter.
+  * ``dense``   — the dense-child fast path: stream compaction by
+    **LSB-first binary shifts**.  Every survivor must move left by
+    ``dist = flat_index - rank``; between consecutive survivors the
+    distance grows by exactly the gap between them, so shifting by
+    2^b (bit b of the remaining distance, b ascending) keeps positions
+    strictly increasing and never collides — the zero-conflict
+    compaction of the N-Queens DFS line of work (arXiv 2511.12009)
+    expressed as log2(M*n) rounds of static slice + select.  The
+    compiled program contains **no sort, no scatter, no searchsorted,
+    and no gather** (jaxpr-pinned by tests/test_compaction.py); its cost
+    is ~(M*n*log2(M*n)) fully-vectorized selects, independent of the
+    survivor count — which is exactly the regime where survivors are
+    *dense* (N-Queens keeps most slots; the PFSP ub=inf warm-up regime
+    prunes nothing) and the S-proportional gather modes pay the most.
+
+All four produce identical ids in identical order (pinned).  ``auto`` (the
+default) resolves per (problem, M, n, prune-rate regime, backend) from the
+measured table in ``_auto_compact`` — the same self-tuning contract as
+``--lb2-pairblock auto``; the raw knob rides ``routing_cache_token`` and
+the resolved mode is baked into compiled programs at trace time.
+"""
+
+from __future__ import annotations
+
+MODES = ("scatter", "sort", "search", "dense")
+
+
+def compact_mode() -> str:
+    """The raw ``TTS_COMPACT`` knob: one of ``MODES`` or ``auto`` (the
+    default — resolved per shape by ``resolve_compact_mode``).  Baked into
+    compiled programs at trace time, so the engines carry it in
+    ``routing_cache_token``."""
+    import os
+
+    mode = os.environ.get("TTS_COMPACT", "auto")
+    if mode != "auto" and mode not in MODES:
+        raise ValueError(
+            "TTS_COMPACT must be 'auto', 'scatter', 'sort', 'search', or "
+            f"'dense', got {mode!r}"
+        )
+    return mode
+
+
+def _auto_compact(problem, M: int | None, n: int | None, platform: str) -> str:
+    """The measured ``auto`` table.  Provisional entries come from the
+    round-5 cycle arithmetic (docs/HW_VALIDATION.md) and are updated from
+    BENCH artifacts — ``bench.py pick_compact`` measures all four modes on
+    chip and records what ``auto`` would have picked:
+
+      * N-Queens never prunes: survivors are dense, the scatter serializes
+        on the full M*n grid, and the shift compaction's cost is flat in
+        the survivor count -> ``dense`` (every backend: the CPU tiers only
+        see test-sized chunks).
+      * non-TPU backends: ``scatter`` is a fast gather-like op on CPU and
+        sort LOSES ~2x (the original measured default) -> unchanged.
+      * TPU, small grids (M*n <= 64k — the tuned PFSP M=1024 class): the
+        log-shift passes are near-free and dodge the serialized scatter
+        -> ``dense``.
+      * TPU, no-prune PFSP regime (ub=inf warm-up): dense survivors
+        -> ``dense``.
+      * TPU, large pruned grids: survivors are sparse, so the
+        S-proportional binary-search inverse does the least work
+        -> ``search``.
+    """
+    if getattr(problem, "name", None) == "nqueens":
+        return "dense"
+    if platform != "tpu":
+        return "scatter"
+    if M is not None and n is not None and M * n <= (1 << 16):
+        return "dense"
+    from ..problems.base import INF_BOUND
+
+    if getattr(problem, "initial_ub", 0) >= INF_BOUND:
+        return "dense"
+    return "search"
+
+
+def resolve_compact_mode(problem=None, M: int | None = None,
+                         n: int | None = None, device=None) -> str:
+    """The resolved compaction mode a resident program bakes in: the
+    explicit knob when set, else the ``auto`` policy.  Every input that
+    shapes the decision is already part of the engines' program cache keys
+    (problem instance, M, device), so a knob flip or shape change rebuilds
+    instead of reusing a stale path."""
+    mode = compact_mode()
+    if mode != "auto":
+        return mode
+    if device is not None:
+        platform = getattr(device, "platform", "cpu")
+    else:
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:
+            platform = "cpu"
+    return _auto_compact(problem, M, n, platform)
+
+
+def _shift_left(x, s: int):
+    """x shifted s positions toward index 0 along axis 0, zero-filled at
+    the tail (a static concat+slice — never a gather)."""
+    import jax.numpy as jnp
+
+    pad = jnp.zeros((s,) + x.shape[1:], x.dtype)
+    return jnp.concatenate([x[s:], pad], axis=0)
+
+
+def shift_compact(dist, payloads: tuple):
+    """Stable left-packing by LSB-first binary shifts.
+
+    ``dist``: (L,) int32 — how far each element must move toward index 0
+    (0 for non-survivors, ``index - rank`` for survivors; between
+    consecutive survivors dist grows by exactly their index gap, which is
+    what makes the per-bit shifts collision-free — see module docstring).
+    ``payloads``: arrays with leading axis L, moved in lockstep.
+
+    Invariant per round b (ascending): every survivor sits at
+    ``index - (dist mod 2^(b+1))`` and survivor positions stay strictly
+    increasing; a vacated position that nothing lands on is marked dead
+    (dist 0) so its stale copy can never move again and shadow a live
+    element.  After the last round, ranks 0..count-1 hold the survivors in
+    order; everything past them is garbage (dead by the pool contract).
+    """
+    import jax.numpy as jnp
+
+    L = dist.shape[0]
+    for b in range(max(1, int(L - 1).bit_length())):
+        s = 1 << b
+        if s >= L:
+            break
+        sh_d = _shift_left(dist, s)
+        take = (sh_d & s) != 0
+        moving = (dist & s) != 0
+        payloads = tuple(
+            jnp.where(take.reshape((-1,) + (1,) * (p.ndim - 1)),
+                      _shift_left(p, s), p)
+            for p in payloads
+        )
+        dist = jnp.where(take, sh_d - s, jnp.where(moving, 0, dist))
+    return payloads
+
+
+def survivor_ranks(keep):
+    """Hierarchical survivor ranks of a (M, n) keep mask — lane scan +
+    per-parent prefix, much cheaper than a flat M*n cumsum.  Returns
+    ``(ranks, tree_inc)``: ranks (M, n) int32 in (parent, slot) order and
+    the survivor count."""
+    import jax.numpy as jnp
+
+    cnt = jnp.sum(keep, axis=1, dtype=jnp.int32)  # (M,)
+    offs = jnp.cumsum(cnt) - cnt  # exclusive prefix
+    lane = jnp.cumsum(keep.astype(jnp.int32), axis=1) - keep
+    return offs[:, None] + lane, offs[-1] + cnt[-1]
+
+
+def compact_ids(keep, S: int, mode: str):
+    """Stream-compaction indices of the surviving (parent, slot) pairs.
+
+    keep: (M, n) bool.  Returns (ids, tree_inc): ids (S,) int32 such that
+    ids[s] = flat index i*n+k of the s-th survivor in (parent, slot) order
+    for s < tree_inc (the reference's child push order,
+    `pfsp_gpu_chpl.chpl:276-298`); rows past tree_inc resolve arbitrarily
+    but stay in-bounds.  ``mode`` selects the rank inversion (module
+    docstring); all modes return identical ids in identical order
+    (pinned by tests/test_compaction.py and CI's per-mode tier-1 runs).
+    """
+    import jax.numpy as jnp
+
+    M, n = keep.shape
+    Mn = M * n
+    ranks, tree_inc = survivor_ranks(keep)
+    flat = keep.reshape(Mn)
+    if mode == "dense":
+        flat_idx = jnp.arange(Mn, dtype=jnp.int32)
+        dist = jnp.where(flat, flat_idx - ranks.reshape(Mn), 0)
+        (ids,) = shift_compact(dist, (flat_idx,))
+        return ids[:S], tree_inc
+    if mode == "sort":
+        key = jnp.where(flat, ranks.reshape(Mn), jnp.int32(Mn))
+        ids = jnp.argsort(key, stable=True)[:S].astype(jnp.int32)
+        return ids, tree_inc
+    if mode == "search":
+        # Binary-search inverse: for output rank s, its parent is the last
+        # p with offs[p] <= s (zero-count parents share the next parent's
+        # offs, so side='right' skips them), and its slot is the lane
+        # whose exclusive cumsum equals the within-parent rank. log2(M)
+        # vectorized gather rounds + one (S, n) lane pass — no scatter, no
+        # sort; the clips keep dead rows in-bounds.
+        cnt = jnp.sum(keep, axis=1, dtype=jnp.int32)
+        offs = jnp.cumsum(cnt) - cnt
+        lane = ranks - offs[:, None]
+        pos = jnp.arange(S, dtype=jnp.int32)
+        parent = jnp.clip(
+            jnp.searchsorted(offs, pos, side="right").astype(jnp.int32) - 1,
+            0, M - 1,
+        )
+        r = pos - offs[parent]  # within-parent rank
+        krows = keep[parent]  # (S, n)
+        lane_s = lane[parent]  # (S, n) exclusive lane cumsum
+        slot = jnp.argmax((lane_s == r[:, None]) & krows, axis=1)
+        ids = (parent * n + slot).astype(jnp.int32)
+        return ids, tree_inc
+    if mode != "scatter":
+        raise ValueError(f"unknown compaction mode {mode!r}")
+    flat_idx = jnp.arange(Mn, dtype=jnp.int32)
+    # Non-survivors get distinct out-of-bounds destinations so the scatter
+    # is genuinely unique-indexed (mode="drop" discards them).
+    dst = jnp.where(flat, ranks.reshape(Mn), S + flat_idx)
+    ids = (
+        jnp.zeros((S,), jnp.int32)
+        .at[dst]
+        .set(flat_idx, mode="drop", unique_indices=True)
+    )
+    return ids, tree_inc

@@ -748,30 +748,14 @@ def lb2_staged_enabled(device=None, n: int | None = None) -> bool:
 
 
 def compact_mode() -> str:
-    """``TTS_COMPACT`` selects the stream-compaction implementation baked
-    into the resident programs at trace time (`engine/resident.py
-    _compact_ids`): ``scatter`` (the original inverse-permutation scatter,
-    default), ``sort`` (stable argsort of ranked keys), or ``search``
-    (binary-search inverse — log2(M) gather rounds, no scatter and no
-    sort). Motivation:
-    XLA:TPU lowers large general scatters to a mostly-serial loop (tens of
-    ns per index), and the round-5 cycle arithmetic puts the (M*n)-index
-    compaction scatter as the dominant non-evaluator cost at every chunk
-    size — the sort form instead uses the TPU's vectorized sort. On CPU
-    the scatter is a fast gather-like op and sort LOSES ~2x, so the
-    default stays ``scatter`` until a hardware measurement flips it;
-    ``bench.py`` compares both on chip and picks empirically per run.
-    Both produce identical ids in identical order; CI pins parity across
-    the knob. Lives here, next to the other routing knobs, so the token
-    below never imports upward from the engine layer."""
-    import os
+    """The raw ``TTS_COMPACT`` knob (``auto`` default — see
+    `ops/compaction.py` for the mode catalogue, the shift-based ``dense``
+    fast path, and the measured ``auto`` table).  Re-exported here so the
+    routing token below and its existing import sites keep one spelling;
+    the survivor-path implementations live in `ops/compaction.py`."""
+    from .compaction import compact_mode as _raw
 
-    mode = os.environ.get("TTS_COMPACT", "scatter")
-    if mode not in ("scatter", "sort", "search"):
-        raise ValueError(
-            f"TTS_COMPACT must be 'scatter', 'sort', or 'search', got {mode!r}"
-        )
-    return mode
+    return _raw()
 
 
 def routing_cache_token(problem, device=None) -> tuple:

@@ -390,6 +390,10 @@ def _host_loop(
             "nodes_received": nodes_received,
         },
         "complete": completed,
+        # Survivor-path mode the per-host SPMD step baked in (identical on
+        # every host: same knob, same problem shape, same device platform).
+        "compact": program.inner.compact,
+        "compact_auto": program.inner.compact_auto,
         # Host-local counter totals (not reduced — per-host telemetry).
         "obs": (
             {"device_counters": ctr_total} if ctr_total is not None else None
@@ -410,6 +414,8 @@ def _reduce(local: dict, coll) -> SearchResult:
         steals=coll.allreduce_sum(local["steals"]),
         comm=comm,
         complete=bool(coll.allreduce_min(int(local["complete"]))),
+        compact=local.get("compact"),
+        compact_auto=local.get("compact_auto", False),
         obs=local.get("obs"),
     )
 

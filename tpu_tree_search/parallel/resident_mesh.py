@@ -591,6 +591,8 @@ def mesh_resident_search(
                 diagnostics=diagnostics,
                 per_worker_tree=per_worker.tolist(),
                 complete=False,
+                compact=program.inner.compact,
+                compact_auto=program.inner.compact_auto,
                 obs=obs_result(),
             )
         if cy == 0 and prev_sizes is not None and np.array_equal(sizes, prev_sizes):
@@ -659,5 +661,7 @@ def mesh_resident_search(
         phases=phases,
         diagnostics=diagnostics,
         per_worker_tree=per_worker.tolist(),
+        compact=program.inner.compact,
+        compact_auto=program.inner.compact_auto,
         obs=obs_result(),
     )
