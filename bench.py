@@ -741,6 +741,178 @@ def _compact_ctx(stats):
     return nullcontext()
 
 
+def _drive_dispatch_loop(problem, m: int, M: int, K: int, depth: int,
+                         half_lat_s: float) -> tuple[int, float]:
+    """Drive the resident program's dispatch loop by hand at a given
+    pipeline depth with an injected host round-trip latency: sleep
+    ``half_lat_s`` before each enqueue (command travel) and after each
+    scalar read (response travel) — the tunnel model. Returns
+    ``(dispatches, wall_seconds)`` of the device phase. Deterministic for
+    a fixed (problem, m, M, K): both depths run the identical dispatch
+    sequence, so the wall delta is pure overlap."""
+    from collections import deque
+
+    import jax
+
+    from tpu_tree_search.engine.device import warmup
+    from tpu_tree_search.engine.resident import (
+        _make_program,
+        resolve_capacity,
+    )
+    from tpu_tree_search.pool import SoAPool
+    from tpu_tree_search.problems.base import INF_BOUND, index_batch
+
+    capacity, M = resolve_capacity(problem, M, None)
+    prog = _make_program(problem, m, M, K, capacity, jax.devices()[0])
+    pool = SoAPool(problem.node_fields())
+    pool.push_back(index_batch(problem.root(), 0))
+    best = getattr(problem, "initial_ub", INF_BOUND)
+    _, _, best = warmup(problem, pool, best, m)
+    state = prog.init_state(pool.as_batch(), best)
+    q: deque = deque()
+    dispatches = 0
+    done = None
+    t0 = time.perf_counter()
+    while True:
+        while len(q) < depth:
+            if half_lat_s:
+                time.sleep(half_lat_s)  # command latency (host -> device)
+            out = prog.step(state)
+            state = prog.carry(out)
+            q.append(out)
+        # Keep each consumed output bound one iteration longer (`done`):
+        # on the CPU backend, dropping an output tuple whose pool buffers
+        # were donated into a still-in-flight dispatch blocks in the
+        # destructor until that dispatch finishes — which would silently
+        # serialize the pipeline this harness exists to measure.
+        done = q.popleft()
+        size = prog.read_scalars(done)[3]
+        if half_lat_s:
+            time.sleep(half_lat_s)  # response latency (device -> host)
+        dispatches += 1
+        if size < m:
+            while q:  # speculative no-ops
+                done = q.popleft()
+                prog.read_scalars(done)
+            break
+    return dispatches, time.perf_counter() - t0
+
+
+def simulated_latency_ab(problem=None, m: int = 25, M: int = 512,
+                         K: int = 8, half_lat_s: float | None = None) -> dict:
+    """Pipeline A/B on the simulated-latency CPU harness: the same full
+    search driven at depth 1 (synchronous — every dispatch pays the
+    injected round trip with the device idle) vs depth 2 (speculative —
+    the round trip overlaps device compute). The expected per-dispatch
+    drop is ``min(T_dev, round_trip)``; the default latency is calibrated
+    to ~60%% of the measured per-dispatch device time so the full
+    round-trip drop is achievable, which is exactly the regime of the real
+    tunnel (~360 ms round trips vs multi-K-cycle dispatch blocks)."""
+    if problem is None:
+        from tpu_tree_search.problems import NQueensProblem
+
+        problem = NQueensProblem(N=11)
+    # Calibrate: latency-free depth-1 passes measure T_dev per dispatch —
+    # the first warms the compile, the second is the measurement.
+    _drive_dispatch_loop(problem, m, M, K, depth=1, half_lat_s=0.0)
+    n0, t_cal = _drive_dispatch_loop(problem, m, M, K, depth=1,
+                                     half_lat_s=0.0)
+    t_dev = t_cal / max(n0, 1)
+    if half_lat_s is None:
+        # round_trip = 0.6 * T_dev keeps T_dev > round_trip, the regime
+        # where depth 2 hides the FULL round trip (drop = min(T_dev, L)).
+        half_lat_s = max(0.002, 0.3 * t_dev)
+    n1, t1 = _drive_dispatch_loop(problem, m, M, K, 1, half_lat_s)
+    n2, t2 = _drive_dispatch_loop(problem, m, M, K, 2, half_lat_s)
+    per1 = t1 / max(n1, 1)
+    per2 = t2 / max(n2, 1)
+    return {
+        "dispatches": n1,
+        "t_dev_ms": round(1e3 * t_dev, 3),
+        "round_trip_ms": round(1e3 * 2 * half_lat_s, 3),
+        "depth1_ms_per_dispatch": round(1e3 * per1, 3),
+        "depth2_ms_per_dispatch": round(1e3 * per2, 3),
+        "drop_ms_per_dispatch": round(1e3 * (per1 - per2), 3),
+    }
+
+
+def _dispatch_latency_rows(extras: list, on_tpu: bool) -> None:
+    """Dispatch-latency microbench rows (never fail the bench):
+
+    * ``dispatch_pipeline_sim_ab`` — the simulated-latency CPU harness
+      above, on every backend (the no-TPU-window proof that depth 2 hides
+      the scalar-read round trip).
+    * on TPU: per-dispatch host wall at K=1 vs K=max, depth 1 vs 2, on the
+      headline config — bounded by max_steps so each cell costs a few
+      dispatches; these are the numbers that show the ~360 ms tunnel round
+      trip amortized (K) and overlapped (depth).
+    """
+    try:
+        extras.append({
+            "metric": "dispatch_pipeline_sim_ab",
+            **simulated_latency_ab(),
+        })
+    except Exception as e:  # noqa: BLE001
+        extras.append({
+            "metric": "dispatch_pipeline_sim_ab",
+            "error": f"{type(e).__name__}: {e}",
+        })
+    if not on_tpu:
+        return
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import PFSPProblem
+
+    for K, steps in ((1, 32), (4096, 4)):
+        for depth in (1, 2):
+            metric = f"dispatch_wall_K{K}_depth{depth}_ms"
+            try:
+                with _env_override("TTS_PIPELINE", str(depth)):
+                    prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+                    resident_search(prob, m=25, M=HEADLINE_M, K=K,
+                                    max_steps=1)  # warm
+                    res = resident_search(prob, m=25, M=HEADLINE_M, K=K,
+                                          max_steps=steps)
+                dev_s = (res.phases[1].seconds if len(res.phases) > 1
+                         else res.elapsed)
+                # Pipelining drains up to depth-1 extra dispatches at cut.
+                n_disp = steps + depth - 1
+                extras.append({
+                    "metric": metric,
+                    "value": round(1e3 * dev_s / max(n_disp, 1), 3),
+                    "unit": "ms/dispatch",
+                    "dispatches": n_disp,
+                    "cycles": res.diagnostics.kernel_launches,
+                })
+            except Exception as e:  # noqa: BLE001
+                extras.append({
+                    "metric": metric, "error": f"{type(e).__name__}: {e}",
+                })
+    # Headline-config pipeline on/off A/B (bounded): same K, same steps,
+    # only TTS_PIPELINE flips — the wall delta is the hidden round trip.
+    try:
+        from tpu_tree_search.engine.resident import resident_search
+
+        prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+        walls = {}
+        for depth in (1, 2):
+            with _env_override("TTS_PIPELINE", str(depth)):
+                resident_search(prob, m=25, M=HEADLINE_M, max_steps=1)
+                res = resident_search(prob, m=25, M=HEADLINE_M, max_steps=8)
+            walls[depth] = (res.phases[1].seconds if len(res.phases) > 1
+                            else res.elapsed)
+        extras.append({
+            "metric": "pipeline_ab_headline",
+            "depth1_s": round(walls[1], 3),
+            "depth2_s": round(walls[2], 3),
+            "speedup": round(walls[1] / max(walls[2], 1e-9), 3),
+        })
+    except Exception as e:  # noqa: BLE001
+        extras.append({
+            "metric": "pipeline_ab_headline",
+            "error": f"{type(e).__name__}: {e}",
+        })
+
+
 def run_config(problem, m: int, M: int):
     """Warm-up run (compiles) + measured run; returns
     (result, nodes/s, elapsed, device_phase_s)."""
@@ -837,7 +1009,11 @@ def main() -> int:
         if express:
             pass  # no microbench: every compile second counts
         elif on_tpu and pallas_ok:
-            mb_pallas = eval_microbench(prob_hl, on_tpu)
+            # The lb1 family is demoted to jnp by default (TTS_PALLAS=force
+            # re-arms it — docs/HW_VALIDATION.md decision record), so the
+            # kernel arm of the A/B must force the route explicitly.
+            with _env_override("TTS_PALLAS", "force"):
+                mb_pallas = eval_microbench(prob_hl, on_tpu)
             with _env_override("TTS_PALLAS", "0"):
                 mb_jnp = eval_microbench(prob_hl, on_tpu)
             micro = {"pallas": mb_pallas, "jnp": mb_jnp}
@@ -867,6 +1043,10 @@ def main() -> int:
         def _headline_run():
             if headline_path == "jnp" and pallas_ok:
                 with _env_override("TTS_PALLAS", "0"):
+                    return run_config(prob_hl, m=25, M=HEADLINE_M)
+            if headline_path == "pallas":
+                # Demoted-by-default lb1 kernels need the force spelling.
+                with _env_override("TTS_PALLAS", "force"):
                     return run_config(prob_hl, m=25, M=HEADLINE_M)
             return run_config(prob_hl, m=25, M=HEADLINE_M)
 
@@ -970,6 +1150,10 @@ def main() -> int:
     # mode skips them all and shares the finalization tail below) ----------
     if not express:
         _collect_extras(extras, on_tpu, staged_ok, staged_err)
+        # Dispatch-latency microbench: K=1 vs K=max × depth 1 vs 2 rows +
+        # the headline pipeline on/off A/B (TPU) and the simulated-latency
+        # CPU harness row (every backend).
+        _dispatch_latency_rows(extras, on_tpu)
     # Published-config rate rows run in BOTH modes (bounded — a few
     # dispatches each), so any green window banks a first ta021/N16/N17
     # number automatically.

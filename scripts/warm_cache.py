@@ -91,6 +91,24 @@ CONFIGS: list[tuple[str, list[str], dict[str, str]]] = [
      {"TTS_LB2_STAGED": "0"}),
     ("ta014 lb1 M=1024 jnp", ["pfsp", "14", "lb1", "-", "1024"],
      {"TTS_PALLAS": "0"}),
+    # TTS_K=auto ladder programs for the headline config (geometric rungs
+    # 1..1024; the default row below covers 4096): the adaptive controller
+    # climbs through every rung from the bottom, and each rung is a
+    # distinct while-loop compile — bank them all so an auto-K session
+    # resizes through cache hits instead of paying ~30s per rung
+    # (engine/pipeline.py AdaptiveK; zero steady-state recompiles).
+    ("ta014 lb1 M=1024 K=1", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_K": "1"}),
+    ("ta014 lb1 M=1024 K=4", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_K": "4"}),
+    ("ta014 lb1 M=1024 K=16", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_K": "16"}),
+    ("ta014 lb1 M=1024 K=64", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_K": "64"}),
+    ("ta014 lb1 M=1024 K=256", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_K": "256"}),
+    ("ta014 lb1 M=1024 K=1024", ["pfsp", "14", "lb1", "-", "1024"],
+     {"TTS_K": "1024"}),
     # Default knob is TTS_COMPACT=auto now (survivor-path overhaul): the
     # unpinned rows below warm the AUTO programs (dense at these shapes);
     # the explicit compact=... variants warm the A/B counterparts.

@@ -104,6 +104,22 @@ def test_all_tiers_match_sequential_pairblocked_lb2(seed, pairblock, staged,
     _fuzz_all_tiers(seed, "lb2")
 
 
+@pytest.mark.parametrize("pipeline,kmode", [("0", None), ("2", "auto")])
+def test_all_tiers_match_sequential_pipeline_axis(pipeline, kmode,
+                                                  monkeypatch):
+    """Dispatch-pipeline axis (engine/pipeline.py): speculative pipelined
+    dispatch is EXACT — every tier must land the sequential counts with
+    pipelining off (TTS_PIPELINE=0, the synchronous pre-pipeline loops)
+    and with one speculative dispatch in flight plus the adaptive
+    geometric-ladder K controller (TTS_PIPELINE=2 + TTS_K=auto, the
+    defaults-and-then-some).  Bit-parity across this axis is the ISSUE 5
+    acceptance criterion."""
+    monkeypatch.setenv("TTS_PIPELINE", pipeline)
+    if kmode is not None:
+        monkeypatch.setenv("TTS_K", kmode)
+    _fuzz_all_tiers(211, "lb1")
+
+
 @pytest.mark.parametrize("mode", ["dense", "auto"])
 def test_all_tiers_match_sequential_compact_axis(mode, monkeypatch):
     """Compaction-path axis (survivor-path overhaul): every tier — the

@@ -52,9 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
         "1024 for PFSP device tiers on TPU, else the reference's 50000; "
         "see docs/HW_VALIDATION.md chunk-size tuning)",
     )
-    common.add_argument("--K", type=int, default=None,
+    common.add_argument("--K", type=str, default=None,
                         help="resident tiers: device chunk cycles per host "
-                        "dispatch (default 4096 device / 16 mesh)")
+                        "dispatch (default 4096 device / 16 mesh), or "
+                        "'auto' — resize K along a geometric ladder toward "
+                        "a target host period (also TTS_K=auto; "
+                        "engine/pipeline.py)")
     common.add_argument(
         "--D", type=int, default=None,
         help="number of devices/shards (mesh, multi, dist tiers); "
@@ -186,6 +189,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def validate_args(parser: argparse.ArgumentParser, args) -> None:
     """Reject flag combinations that would otherwise be silently ignored."""
+    if args.K is not None and args.K != "auto":
+        try:
+            args.K = int(args.K)
+        except ValueError:
+            parser.error("--K must be 'auto' or a positive integer")
+        if args.K < 1:
+            parser.error("--K must be >= 1 (or 'auto')")
     if args.guard and not (
         args.tier in ("mesh", "dist_mesh")
         or (args.tier == "device" and args.engine == "resident")
@@ -476,6 +486,14 @@ def print_settings(args) -> None:
 
         knob = args.compact or os.environ.get("TTS_COMPACT", "auto")
         print(f"Survivor path (TTS_COMPACT): {knob}")
+        # Raw dispatch-pipeline knobs; the RESOLVED depth/K are printed
+        # with the results (auto may resize K along the ladder mid-run).
+        pknob = os.environ.get("TTS_PIPELINE", "auto") or "auto"
+        kknob = os.environ.get("TTS_K") or (
+            args.K if args.K is not None else "default"
+        )
+        print(f"Dispatch pipeline (TTS_PIPELINE): {pknob}; "
+              f"K schedule (TTS_K): {kknob}")
     print("=================================================")
 
 
@@ -510,11 +528,20 @@ def print_results(args, problem, res) -> None:
     if res.compact:
         tag = " (auto)" if res.compact_auto else ""
         print(f"Survivor path: {res.compact}{tag}")
+    if res.k_resolved is not None:
+        tag = " (auto)" if res.k_auto else ""
+        print(f"Dispatch pipeline: depth={res.pipeline_depth}, "
+              f"K={res.k_resolved}{tag}")
     d = res.diagnostics
     if d.kernel_launches:
+        dbuf = (
+            f" double_buffered={d.double_buffered}"
+            if d.double_buffered else ""
+        )
         print(
             f"Device diagnostics: kernel_launch={d.kernel_launches} "
-            f"host_to_device={d.host_to_device} device_to_host={d.device_to_host}"
+            f"host_to_device={d.host_to_device} "
+            f"device_to_host={d.device_to_host}{dbuf}"
         )
     if res.steals:
         print(f"Work steals (intra-host): {res.steals}")
@@ -577,6 +604,14 @@ def result_record(args, res) -> dict:
             )
             if res.compact_auto:
                 rec["compact_auto"] = True
+            # Pipeline depth + the K the run ended on (auto may have
+            # resized along the ladder) — the stats line must prove which
+            # dispatch regime produced the number.
+            rec["pipeline_depth"] = res.pipeline_depth
+            if res.k_resolved is not None:
+                rec["k"] = res.k_resolved
+            if res.k_auto:
+                rec["k_auto"] = True
         if args.problem == "pfsp" and args.lb == "lb2":
             # Staging applies at every mp: under mp > 1 the compacted self
             # bound shards its pair loop with a pmax combine. The job count

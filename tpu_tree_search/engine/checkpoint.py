@@ -37,7 +37,15 @@ class RunController:
     """Shared max-steps / periodic-checkpoint bookkeeping for the resident
     tiers. ``snapshot_fn() -> (batch, best)`` downloads the live frontier;
     ``after_step(tree, sol)`` returns True when the run must stop now (the
-    cutoff checkpoint, if requested, has already been written)."""
+    cutoff checkpoint, if requested, has already been written).
+
+    ``drain_fn() -> (tree_inc, sol_inc)``: under pipelined dispatch
+    (engine/pipeline.py) the frontier snapshot includes the work of every
+    in-flight speculative dispatch, so a cut must first drain their scalar
+    counts or the saved counters would lag the saved frontier (a resumed
+    run would under-count).  Called exactly once, right before a snapshot
+    is taken; the engine's drain also folds the increments into its own
+    running totals."""
 
     def __init__(
         self,
@@ -46,6 +54,7 @@ class RunController:
         interval_s: float,
         max_steps: int | None,
         snapshot_fn,
+        drain_fn=None,
     ):
         import time
 
@@ -54,11 +63,16 @@ class RunController:
         self.interval_s = interval_s
         self.max_steps = max_steps
         self.snapshot_fn = snapshot_fn
+        self.drain_fn = drain_fn
         self.steps = 0
         self._clock = time.monotonic
         self._last = self._clock()
 
     def _save(self, tree: int, sol: int) -> None:
+        if self.drain_fn is not None:
+            dt, ds = self.drain_fn()
+            tree += dt
+            sol += ds
         batch, best = self.snapshot_fn()
         save(self.path, self.problem, batch, best, tree, sol)
 

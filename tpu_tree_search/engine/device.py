@@ -83,6 +83,16 @@ class DeviceOffloader:
 
     Counts launches/copies like Chapel's GpuDiagnostics
     (`pfsp_gpu_chpl.chpl:454-466`).
+
+    Double-buffered staging: ``stage()`` copies a popped chunk into one of
+    TWO reusable bucket-sized host buffers per bucket shape (pre-padded, no
+    per-chunk allocation), alternating buffers so chunk k+1 can stage and
+    ``device_put`` while chunk k's staged buffer still backs an in-flight
+    dispatch — the H2D of the next chunk overlaps the device evaluation of
+    the current one. Two buffers are exactly enough for the drivers'
+    one-pending overlap discipline (dispatch k+1 before consuming k);
+    ``Diagnostics.double_buffered`` counts the dispatches that actually
+    overlapped an in-flight one.
     """
 
     def __init__(self, problem: Problem, device=None):
@@ -92,19 +102,55 @@ class DeviceOffloader:
         self.device = device if device is not None else jax.devices()[0]
         self._evaluate = problem.make_device_evaluator(self.device)
         self.diagnostics = Diagnostics()
+        # bucket -> [buf, buf] of {name: np.ndarray((bucket,)+shape)};
+        # allocated lazily on first use of each bucket shape.
+        self._staging: dict[int, list] = {}
+        self._flip: dict[int, int] = {}
 
-    def dispatch(self, parents_np: dict, count: int, bucket: int, best: int):
-        """H2D + async kernel dispatch; returns an unmaterialized device result."""
+    def stage(self, chunk: dict, count: int, bucket: int) -> dict:
+        """Copy+pad ``chunk[:count]`` into the bucket's next staging buffer
+        (the `pad_chunk` convention: tail slots clone row 0) and return it.
+        The returned dict stays valid until the SECOND-next ``stage`` of
+        the same bucket — long enough for the one-pending overlap."""
+        bufs = self._staging.setdefault(bucket, [None, None])
+        i = self._flip.get(bucket, 0)
+        self._flip[bucket] = 1 - i
+        buf = bufs[i]
+        if buf is None:
+            buf = bufs[i] = {
+                name: np.empty((bucket,) + arr.shape[1:], dtype=arr.dtype)
+                for name, arr in chunk.items()
+            }
+        for name, arr in chunk.items():
+            dst = buf[name]
+            dst[:count] = arr[:count]
+            if count < bucket:
+                dst[count:] = arr[0]
+        return buf
+
+    def dispatch_staged(self, staged: dict, count: int, best: int,
+                        overlapped: bool = False):
+        """H2D + async kernel dispatch of an already-padded staging buffer;
+        returns an unmaterialized device result. ``overlapped=True`` records
+        that another dispatch was still in flight (the double-buffer
+        counter the bench/report read)."""
         import jax
 
-        padded = pad_chunk(parents_np, count, bucket)
         parents_dev = {
-            k: jax.device_put(v, self.device) for k, v in padded.items()
+            k: jax.device_put(v, self.device) for k, v in staged.items()
         }
         self.diagnostics.host_to_device += 1
+        if overlapped:
+            self.diagnostics.double_buffered += 1
         result = self._evaluate(parents_dev, count, best)
         self.diagnostics.kernel_launches += 1
         return result
+
+    def dispatch(self, parents_np: dict, count: int, bucket: int, best: int):
+        """Classic one-shot path (pads a fresh snapshot): kept for the rare
+        overflow-fallback call sites that dispatch synchronously."""
+        padded = pad_chunk(parents_np, count, bucket)
+        return self.dispatch_staged(padded, count, best)
 
     def collect(self, result) -> np.ndarray:
         """D2H (blocks until the device result is ready)."""
@@ -191,7 +237,7 @@ def device_search(
     tree2 = 0
     sol2 = 0
     chunk_buf = problem.empty_batch(M)
-    pending = None  # (parents_np_snapshot, count, device_result)
+    pending = None  # (staged_buffer, count, device_result)
 
     def consume(p):
         nonlocal tree2, sol2, best
@@ -212,15 +258,21 @@ def device_search(
                 continue  # children may refill the pool past m
             break
         bucket = bucket_size(count, m, M)
-        snapshot = {k: v[:count].copy() for k, v in chunk_buf.items()}
-        dev_result = off.dispatch(snapshot, count, bucket, best)
+        # Double-buffered staging: the copy+pad reuses one of two
+        # bucket-sized host buffers, so staging+H2D of this chunk overlaps
+        # the in-flight evaluation of the pending one (no per-chunk
+        # allocation; the pending chunk's buffer is the other one).
+        staged = off.stage(chunk_buf, count, bucket)
+        dev_result = off.dispatch_staged(
+            staged, count, best, overlapped=pending is not None
+        )
         if overlap and pending is not None:
             consume(pending)
-            pending = (snapshot, count, dev_result)
+            pending = (staged, count, dev_result)
         elif overlap:
-            pending = (snapshot, count, dev_result)
+            pending = (staged, count, dev_result)
         else:
-            consume((snapshot, count, dev_result))
+            consume((staged, count, dev_result))
     t2 = time.perf_counter()
     phases.append(PhaseStats(t2 - t1, tree2, sol2))
 

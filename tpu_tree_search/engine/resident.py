@@ -341,6 +341,25 @@ class _ResidentProgram:
         """One dispatch: up to K device-side chunk cycles."""
         return self._step(*state)
 
+    def carry(self, out):
+        """The dispatch's carried state ``(pool_vals, pool_aux, size,
+        best)`` — the next dispatch's input.  Pure tuple slicing: nothing
+        is forced, so a speculative dispatch can be enqueued on it while
+        the producing computation is still in flight."""
+        return tuple(out[:4])
+
+    def read_scalars(self, out):
+        """Blocks on the dispatch's SCALAR outputs only: returns
+        ``(tree, sol, cycles, size, best, ctr)``.  Never touches the pool
+        leaves — under pipelined dispatch those buffers were already
+        donated into the next speculative dispatch and are dead; the
+        scalar outputs are not donated and stay readable.  This is the
+        sanctioned per-dispatch readback (a few ints + the optional obs
+        counter block), same bytes as the synchronous path always read."""
+        ctr = np.asarray(out[7]) if self.obs else None
+        return (int(out[4]), int(out[5]), int(out[6]),
+                int(out[2]), int(out[3]), ctr)
+
     def read(self, out):
         """Blocks on the step result; returns ``(state, tree, sol, cycles,
         ctr)`` where ``ctr`` is the harvested counter block (np array) when
@@ -606,7 +625,7 @@ def resident_search(
     problem: Problem,
     m: int = 25,
     M: int = 65536,
-    K: int = 4096,
+    K: int | str = 4096,
     capacity: int | None = None,
     device=None,
     initial_best: int | None = None,
@@ -622,6 +641,16 @@ def resident_search(
     Phase 1 (host warm-up) and phase 3 (host drain) are identical to
     `device_search`; phase 2 runs on-device in blocks of up to K chunk
     cycles per dispatch.
+
+    Dispatch is **pipelined** (``TTS_PIPELINE``, engine/pipeline.py):
+    up to depth speculative K-cycle dispatches ride the device queue
+    while the host reads lagged scalars — exact, because a dispatch on a
+    terminated/stalled pool is a zero-cycle no-op. ``K="auto"`` (or
+    ``TTS_K=auto``) enables the adaptive geometric-ladder K controller;
+    an explicit K pins it. Under pipelining a ``max_steps`` cutoff drains
+    the (up to depth-1) in-flight speculative dispatches, so the counted
+    work can exceed ``max_steps`` blocks by that margin — the checkpoint
+    stays coherent either way.
 
     Checkpointing (absent from the reference, SURVEY.md §5): with
     ``checkpoint_path`` the live frontier + counters are saved every
@@ -671,13 +700,106 @@ def resident_search(
     ev.counter("explored", tree=tree1, sol=sol1, phase=1)
 
     # -- phase 2: device-resident loop ------------------------------------
-    program = _make_program(problem, m, M, K, capacity, device)
+    from .pipeline import (
+        AdaptiveK,
+        DispatchQueue,
+        RESIDENT_TARGET,
+        resolve_k,
+        resolve_pipeline_depth,
+    )
+
+    k_auto, k_value = resolve_k(K, default_max=4096)
+    ctl = AdaptiveK(k_value, target=RESIDENT_TARGET) if k_auto else None
+    depth = resolve_pipeline_depth()
+    program = _make_program(problem, m, M, ctl.K if ctl else k_value,
+                            capacity, device)
     state = program.init_state(pool.as_batch(), best)
     pool.clear()
     diagnostics.host_to_device += 1
     tree2 = 0
     sol2 = 0
+    size = pool.size
     offloader = None
+
+    from ..analysis.guard import SteadyStateGuard, guard_enabled
+
+    genabled = guard_enabled(guard)
+    guards: dict[int, SteadyStateGuard] = {}
+
+    def guard_of(prog) -> SteadyStateGuard:
+        # One guard per compiled program: each ladder rung's first dispatch
+        # is its sanctioned warm one; re-selecting a rung reuses its guard
+        # (and its cached executable — zero steady-state recompiles).
+        g = guards.get(id(prog))
+        if g is None:
+            g = guards[id(prog)] = SteadyStateGuard(
+                prog._step, "resident step", enabled=genabled
+            )
+        return g
+
+    ctr_total: dict | None = None
+    fb_tree = fb_sol = 0  # overflow-fallback host increments (obs parity)
+    prev_best = best
+    queue = DispatchQueue(depth)
+
+    def obs_result() -> dict | None:
+        return (
+            {"device_counters": ctr_total} if ctr_total is not None else None
+        )
+
+    def enqueue() -> None:
+        # Speculative pipelined dispatch: the carry chains device-side from
+        # one dispatch's output into the next's input (donated), so up to
+        # `depth` K-cycle blocks ride the device queue while the host is
+        # still reading lagged scalars.  Exact: a dispatch on a terminated
+        # or stalled pool is a zero-cycle no-op (see pipeline.py).
+        nonlocal state
+        t_enq = ev.now_us()
+        with guard_of(program).step():
+            out = program.step(state)
+        state = program.carry(out)
+        queue.push(out, t_enq)
+
+    def consume(out, t_enq) -> tuple[int, int, int]:
+        nonlocal tree2, sol2, size, best, ctr_total, prev_best
+        t_wait = ev.now_us()
+        tree_inc, sol_inc, cycles, size, best, ctr = \
+            program.read_scalars(out)
+        tree2 += tree_inc
+        sol2 += sol_inc
+        diagnostics.kernel_launches += cycles
+        if ctr is not None:
+            ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        if ev.enabled():
+            now = ev.now_us()
+            # Span semantics under pipelining (docs/OBSERVABILITY.md): the
+            # span covers enqueue -> scalars-ready (spans overlap at
+            # depth > 1; `tts report` merges overlaps for the busy
+            # fraction); read_wait_us is the blocked portion alone.
+            ev.emit("dispatch", ph="X", ts=t_enq,
+                    dur=max(0.0, now - t_enq), args={
+                        "cycles": cycles, "tree": tree_inc, "sol": sol_inc,
+                        "size": size, "best": best,
+                        "enqueue_us": t_enq, "read_wait_us": now - t_wait,
+                        "pipeline_depth": depth,
+                    })
+            if ctr is not None:
+                ev.counter("device_counters", **obs_counters.as_args(ctr))
+            if best < prev_best:
+                ev.emit("incumbent", args={"best": best})
+        prev_best = best
+        return tree_inc, sol_inc, cycles
+
+    def drain_queue() -> tuple[int, int]:
+        # Read every in-flight speculative dispatch before any action that
+        # needs coherent totals or the final carried state (termination,
+        # checkpoint cuts, K resizes, the capacity-stall fallback).
+        dt = ds = 0
+        for out, t_enq in queue.drain():
+            ti, si, _ = consume(out, t_enq)
+            dt += ti
+            ds += si
+        return dt, ds
 
     def snapshot_fn():
         batch, _, bst = program.snapshot(state)
@@ -685,49 +807,27 @@ def resident_search(
         return batch, bst
 
     controller = ckpt.RunController(
-        problem, checkpoint_path, checkpoint_interval_s, max_steps, snapshot_fn
+        problem, checkpoint_path, checkpoint_interval_s, max_steps,
+        snapshot_fn, drain_fn=drain_queue,
     )
 
-    from ..analysis.guard import SteadyStateGuard, guard_enabled
-
-    sguard = SteadyStateGuard(
-        program._step, "resident step", enabled=guard_enabled(guard)
-    )
-
-    ctr_total: dict | None = None
-    fb_tree = fb_sol = 0  # overflow-fallback host increments (obs parity)
-    prev_best = best
-
-    def obs_result() -> dict | None:
-        return (
-            {"device_counters": ctr_total} if ctr_total is not None else None
-        )
+    ev.emit("pipeline", args={
+        "depth": depth, "K": program.K, "k_auto": k_auto, "tier": "resident",
+    })
+    last_ready = time.monotonic()
 
     while True:
-        t_disp = ev.now_us()
-        with sguard.step():
-            out = program.step(state)
-        state, tree_inc, sol_inc, cycles, ctr = program.read(out)
-        tree2 += tree_inc
-        sol2 += sol_inc
-        diagnostics.kernel_launches += cycles
-        size = int(state[-2])
-        best = int(state[-1])
-        if ctr is not None:
-            ctr_total = obs_counters.merge_host(ctr_total, ctr)
-        if ev.enabled():
-            ev.complete("dispatch", t_disp, args={
-                "cycles": cycles, "tree": tree_inc, "sol": sol_inc,
-                "size": size, "best": best,
-            })
-            if ctr is not None:
-                ev.counter("device_counters", **obs_counters.as_args(ctr))
-            if best < prev_best:
-                ev.emit("incumbent", args={"best": best})
-        prev_best = best
+        while not queue.full:
+            enqueue()
+        out, t_enq = queue.pop()
+        tree_inc, sol_inc, cycles = consume(out, t_enq)
+        now = time.monotonic()
+        period, last_ready = now - last_ready, now
         if size < m:
+            drain_queue()  # speculative no-ops: zero counts, state intact
             break
         if controller.after_step(tree1 + tree2, sol1 + sol2):
+            drain_queue()  # no-op if the cutoff save already drained
             t2 = time.perf_counter()
             phases.append(PhaseStats(t2 - t1, tree2, sol2))
             ev.emit("checkpoint", args={"cutoff": True})
@@ -742,12 +842,28 @@ def resident_search(
                 complete=False,
                 compact=program.compact,
                 compact_auto=program.compact_auto,
+                pipeline_depth=depth,
+                k_resolved=program.K,
+                k_auto=k_auto,
                 obs=obs_result(),
             )
+        if ctl is not None and cycles > 0 and ctl.observe(period, cycles):
+            # Geometric-ladder K resize: drain, then swap in the rung's
+            # cached program (same pool state arrays — capacity does not
+            # depend on K; at most len(ladder) compiles ever happen).
+            drain_queue()
+            program = _make_program(problem, m, M, ctl.K, capacity, device)
+            ev.emit("k_resize", args={"K": program.K})
+            last_ready = time.monotonic()
+            if size < m:
+                # The drained speculative dispatches finished the search.
+                break
+            continue
         if cycles == 0:
             # Capacity stall: pool too full for another device fan-out. Run
             # classic offload cycles through a host pool until there is
             # headroom again (rare; guarantees progress at any capacity).
+            drain_queue()  # stalled speculative dispatches are no-ops too
             t_fb = ev.now_us()
             fb_tree0, fb_sol0 = tree2, sol2
             batch, size, best = program.residual(state)
@@ -779,7 +895,8 @@ def resident_search(
             diagnostics.host_to_device += 1
             # The re-upload is a sanctioned host round trip; the next
             # dispatch is a fresh warm one for the guard.
-            sguard.rearm()
+            guard_of(program).rearm()
+            last_ready = time.monotonic()
             fb_tree += tree2 - fb_tree0
             fb_sol += sol2 - fb_sol0
             ev.complete("overflow_fallback", t_fb, args={
@@ -809,5 +926,8 @@ def resident_search(
         diagnostics=diagnostics,
         compact=program.compact,
         compact_auto=program.compact_auto,
+        pipeline_depth=depth,
+        k_resolved=program.K,
+        k_auto=k_auto,
         obs=obs_result(),
     )

@@ -29,6 +29,10 @@ class Diagnostics:
     kernel_launches: int = 0
     host_to_device: int = 0
     device_to_host: int = 0
+    # Offload engine: dispatches whose H2D staging overlapped an
+    # in-flight device evaluation (the double-buffer fast path,
+    # `engine/device.py DeviceOffloader.stage`).
+    double_buffered: int = 0
 
 
 @dataclass
@@ -57,6 +61,12 @@ class SearchResult:
     # None for tiers that prune on host and never compact.
     compact: str | None = None
     compact_auto: bool = False
+    # Resident tiers: dispatch-pipeline depth the host loop ran with
+    # (TTS_PIPELINE — 1 = synchronous, >= 2 = speculative), the K the
+    # loop ended on, and whether TTS_K=auto resolved it (engine/pipeline.py).
+    pipeline_depth: int = 1
+    k_resolved: int | None = None
+    k_auto: bool = False
     # Telemetry snapshot (TTS_OBS=1, docs/OBSERVABILITY.md): per-run totals
     # of the on-device counter block harvested at dispatch boundaries
     # ({"device_counters": {popped, pushed, leaves, pruned, overflow,

@@ -43,10 +43,24 @@ def chrome_trace_object(evts: list[dict], label: str = "tts") -> dict:
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
             "args": {"name": _track_name(tid)},
         })
+    other = {"producer": "tpu_tree_search obs"}
+    # Dispatch-pipeline metadata (docs/OBSERVABILITY.md span semantics):
+    # the resident engines emit one "pipeline" instant at phase-2 start;
+    # a reader needs the depth to interpret overlapping dispatch spans.
+    pipe = next(
+        (e.get("args") or {} for e in evts if e.get("name") == "pipeline"),
+        None,
+    )
+    if pipe is not None:
+        other["pipeline_depth"] = pipe.get("depth", 1)
+        if "K" in pipe:
+            other["k_initial"] = pipe["K"]
+        if "k_auto" in pipe:
+            other["k_auto"] = pipe["k_auto"]
     return {
         "traceEvents": meta + evts,
         "displayTimeUnit": "ms",
-        "otherData": {"producer": "tpu_tree_search obs"},
+        "otherData": other,
     }
 
 

@@ -58,7 +58,13 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
     }
 
     # -- idle fraction per worker -----------------------------------------
+    # Busy time is the UNION of dispatch/chunk spans, not their sum: under
+    # pipelined dispatch (TTS_PIPELINE >= 2) a track carries up to `depth`
+    # overlapping enqueue->scalars-ready spans at once, and summing them
+    # would claim more busy time than wall time — the idle/busy fractions
+    # must stay truthful at any depth (docs/OBSERVABILITY.md).
     workers: dict[str, dict] = {}
+    busy_ivals: dict[str, list] = {}
     for e in evts:
         tid = e.get("tid", 0)
         if tid == COMM_TID:
@@ -68,7 +74,20 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
         if e.get("name") == "idle":
             w["idle_us"] += e.get("dur", 0.0)
         elif e.get("name") in ("dispatch", "chunk") and "dur" in e:
-            w["busy_us"] += e["dur"]
+            ts = e.get("ts", 0.0)
+            busy_ivals.setdefault(key, []).append((ts, ts + e["dur"]))
+    for key, ivals in busy_ivals.items():
+        ivals.sort()
+        total = 0.0
+        cur_s, cur_e = ivals[0]
+        for s, e_ in ivals[1:]:
+            if s <= cur_e:
+                cur_e = max(cur_e, e_)
+            else:
+                total += cur_e - cur_s
+                cur_s, cur_e = s, e_
+        total += cur_e - cur_s
+        workers[key]["busy_us"] = total
     idle = {
         key: {
             "idle_fraction": (w["idle_us"] / (t1 - t0)) if t1 > t0 else 0.0,

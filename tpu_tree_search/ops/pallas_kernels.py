@@ -50,6 +50,29 @@ def use_pallas(device=None) -> bool:
         return False
 
 
+def pallas_forced() -> bool:
+    """``TTS_PALLAS=force``: re-arm the demoted lb1-family kernel routing
+    (see ``lb1_pallas_enabled``) — the armed-session A/B spelling."""
+    return os.environ.get("TTS_PALLAS", "") == "force"
+
+
+def lb1_pallas_enabled() -> bool:
+    """lb1-family demotion (decision record: docs/HW_VALIDATION.md).
+
+    The round-5 on-chip microbench measured the fused jnp/XLA lb1 path at
+    ~7x the hand-written Pallas kernel on the production chunk shapes
+    (315M vs 41M bound-evals/s — XLA's own fusion wins on this op), and
+    the bench had been empirically demoting the headline to jnp every
+    round. This makes that measurement the default: the lb1/lb1_d
+    evaluators route to the fused jnp path everywhere, and the kernels
+    stay reachable for the A/B via ``TTS_PALLAS=force`` (interpret mode
+    also still routes through them — it exists to exercise kernel/
+    composition code paths, not to be fast). The lb2 family is NOT
+    demoted: its kernel keeps the whole Johnson pair loop in VMEM and
+    measures faster than jnp on chip."""
+    return pallas_forced() or pallas_interpret()
+
+
 def pallas_interpret() -> bool:
     """``TTS_PALLAS_INTERPRET=1`` routes the evaluators to the Pallas
     kernels in interpret mode on ANY backend. This is the off-chip way to

@@ -448,9 +448,12 @@ def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
 
     # The kernel covers every Taillard size (20-500 jobs): _auto_tile shrinks
     # the batch tile as n grows; shapes that cannot fit VMEM even at the
-    # smallest tile stay on the jnp oracle.
+    # smallest tile stay on the jnp oracle. Demoted by default — the fused
+    # jnp path measured ~7x faster in-kernel on chip (docs/HW_VALIDATION.md
+    # decision record); TTS_PALLAS=force re-arms it for the A/B.
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
-    if PK.use_pallas(device) and n <= 512 and PK.lb1_kernel_feasible(n, m):
+    if (PK.use_pallas(device) and PK.lb1_pallas_enabled() and n <= 512
+            and PK.lb1_kernel_feasible(n, m)):
         return PK.pfsp_lb1_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
             bf16=tables.exact_bf16,
@@ -465,7 +468,8 @@ def lb1_d_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     from . import pallas_kernels as PK
 
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
-    if PK.use_pallas(device) and n <= 512 and PK.lb1_kernel_feasible(n, m):
+    if (PK.use_pallas(device) and PK.lb1_pallas_enabled() and n <= 512
+            and PK.lb1_kernel_feasible(n, m)):
         return PK.pfsp_lb1_d_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
             bf16=tables.exact_bf16,
@@ -769,6 +773,9 @@ def routing_cache_token(problem, device=None) -> tuple:
     from . import pallas_kernels as PK
 
     tok: tuple = (PK.use_pallas(device), PK.pallas_interpret(),
+                  # lb1-family demotion override (TTS_PALLAS=force) is a
+                  # trace-time routing decision like the rest.
+                  PK.pallas_forced(),
                   compact_mode())
     if getattr(problem, "name", None) == "pfsp" and problem.lb == "lb2":
         tok += (

@@ -49,7 +49,7 @@ from .dist import (
     ThreadCollectives,
     secondary_error,
 )
-from .resident_mesh import _MeshResidentProgram, make_dp_mp_mesh
+from .resident_mesh import get_mesh_program, make_dp_mp_mesh
 
 
 def _stride_shards(batch: dict, D: int) -> list[dict]:
@@ -128,27 +128,27 @@ def _host_loop(
     ev.counter("explored", host=me, tree=tree1, sol=sol1, phase=1)
 
     # -- phase 2: per-host SPMD loop + step-boundary exchanges --------------
+    from ..engine.pipeline import (
+        AdaptiveK,
+        DispatchQueue,
+        MESH_TARGET,
+        resolve_k,
+        resolve_pipeline_depth,
+    )
     from ..engine.resident import resolve_capacity
-    from ..ops.pfsp_device import routing_cache_token
 
     capacity, M = resolve_capacity(problem, M, None)
     T = max(2 * m, min(M, 8192))
-    # Same per-problem program cache as mesh_resident_search (a recompile
-    # costs ~30s on TPU), same routing-token keying.
-    cache = getattr(problem, "_mesh_programs", None)
-    if cache is None:
-        cache = problem._mesh_programs = {}
-    key = (
-        tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
-        m, M, K, rounds, T, capacity,
-        routing_cache_token(problem, mesh.devices.flat[0]),
-        obs_counters.device_counters_enabled(),
-    )
-    program = cache.get(key)
-    if program is None:
-        program = cache[key] = _MeshResidentProgram(
-            problem, mesh, m, M, K, rounds, T, capacity
-        )
+    # Per-host adaptive K (TTS_K=auto): each host resizes its own program
+    # along the shared geometric ladder — hosts already run different
+    # cycle counts per exchange round, so differing K across hosts changes
+    # nothing the exchange protocol depends on. The mesh target band keeps
+    # K bounded by exchange responsiveness.
+    k_auto, k_value = resolve_k(K, default_max=16)
+    ctl = AdaptiveK(k_value, target=MESH_TARGET) if k_auto else None
+    depth = resolve_pipeline_depth()
+    program = get_mesh_program(problem, mesh, m, M,
+                               ctl.K if ctl else k_value, rounds, T, capacity)
 
     state = program.init_state(_stride_shards(pool.as_batch(), D), best)
     pool.clear()
@@ -156,9 +156,16 @@ def _host_loop(
 
     from ..analysis.guard import SteadyStateGuard, guard_enabled
 
-    sguard = SteadyStateGuard(
-        program._step, "dist-mesh step", enabled=guard_enabled(None)
-    )
+    genabled = guard_enabled(None)
+    guards: dict[int, SteadyStateGuard] = {}
+
+    def guard_of(prog) -> SteadyStateGuard:
+        g = guards.get(id(prog))
+        if g is None:
+            g = guards[id(prog)] = SteadyStateGuard(
+                prog._step, "dist-mesh step", enabled=genabled
+            )
+        return g
 
     tree2 = 0
     sol2 = 0
@@ -170,8 +177,58 @@ def _host_loop(
     exch_rounds = 0
     per_worker = np.zeros(D, dtype=np.int64)
 
+    ctr_total: dict | None = None
+    prev_best = best
+    sizes = np.zeros(D, dtype=np.int32)
+    queue = DispatchQueue(depth)
+    last_ready = time.monotonic()
+
+    def enqueue() -> None:
+        nonlocal state
+        t_enq = ev.now_us()
+        with guard_of(program).step():
+            out = program.step(state)
+        state = program.carry(out)
+        queue.push(out, t_enq)
+
+    def consume(out, t_enq) -> tuple[int, int, int]:
+        nonlocal tree2, sol2, sizes, best, ctr_total, prev_best, per_worker
+        t_wait = ev.now_us()
+        ti, si, cy, sizes, best, tree_vec, ctr = program.read_scalars(out)
+        tree2 += ti
+        sol2 += si
+        per_worker += tree_vec.astype(np.int64)
+        diagnostics.kernel_launches += cy
+        if ctr is not None:
+            ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        if ev.enabled():
+            now = ev.now_us()
+            ev.emit("dispatch", ph="X", ts=t_enq, host=me,
+                    dur=max(0.0, now - t_enq), args={
+                        "cycles": cy, "tree": ti, "sol": si,
+                        "size": int(sizes.sum()), "best": int(best),
+                        "shard_sizes": sizes.tolist(),
+                        "enqueue_us": t_enq, "read_wait_us": now - t_wait,
+                        "pipeline_depth": depth,
+                    })
+            if ctr is not None:
+                ev.counter("device_counters", host=me,
+                           **obs_counters.as_args(ctr))
+            if best < prev_best:
+                ev.emit("incumbent", host=me, args={"best": int(best)})
+        prev_best = best
+        return ti, si, cy
+
+    def drain_queue() -> None:
+        # Coherence barrier: any action that downloads/snapshots the pool
+        # (donations, lockstep cuts) must first fold every in-flight
+        # speculative dispatch's counts — the frontier includes their work.
+        for out, t_enq in queue.drain():
+            consume(out, t_enq)
+
     def download() -> SoAPool:
         nonlocal best
+        drain_queue()
         batch = program.full_batch(state)
         diagnostics.device_to_host += 1
         p = SoAPool(problem.node_fields())
@@ -179,12 +236,13 @@ def _host_loop(
         return p
 
     def upload(p: SoAPool):
-        nonlocal state
+        nonlocal state, last_ready
         state = program.init_state(_stride_shards(p.as_batch(), D), best)
         diagnostics.host_to_device += 1
         # Donation-round re-uploads are sanctioned host round trips: the
         # next dispatch is a fresh warm one for the steady-state guard.
-        sguard.rearm()
+        guard_of(program).rearm()
+        last_ready = time.monotonic()
 
     import pickle
     import uuid as _uuid
@@ -199,6 +257,7 @@ def _host_loop(
     ckpt_last = time.monotonic()
 
     def do_lockstep_cut(tag) -> None:
+        drain_queue()  # counters must cover the snapshot's in-flight work
         staging = eff_ckpt + ".staging"
         ok = True
         t_cut = ev.now_us()
@@ -216,34 +275,26 @@ def _host_loop(
         ev.complete("checkpoint", t_cut, wid=ev.COMM_TID, host=me,
                     args={"tag": str(tag), "ok": ok})
 
-    ctr_total: dict | None = None
-    prev_best = best
+    ev.emit("pipeline", host=me, args={
+        "depth": depth, "K": program.K, "k_auto": k_auto, "tier": "dist_mesh",
+    })
 
     while True:
-        t_disp = ev.now_us()
-        with sguard.step():
-            out = program.step(state)
-        state, ti, si, cy, sizes, best, tree_vec, ctr = \
-            program.read_stats(out)
-        tree2 += ti
-        sol2 += si
-        per_worker += tree_vec.astype(np.int64)
-        diagnostics.kernel_launches += cy
+        while not queue.full:
+            enqueue()
+        out, t_enq = queue.pop()
+        ti, si, cy = consume(out, t_enq)
+        now = time.monotonic()
+        period, last_ready = now - last_ready, now
         steps += 1
         total = int(sizes.sum())
-        if ctr is not None:
-            ctr_total = obs_counters.merge_host(ctr_total, ctr)
-        if ev.enabled():
-            ev.complete("dispatch", t_disp, host=me, args={
-                "cycles": cy, "tree": ti, "sol": si, "size": total,
-                "best": int(best), "shard_sizes": sizes.tolist(),
-            })
-            if ctr is not None:
-                ev.counter("device_counters", host=me,
-                           **obs_counters.as_args(ctr))
-            if best < prev_best:
-                ev.emit("incumbent", host=me, args={"best": int(best)})
-        prev_best = best
+        if ctl is not None and cy > 0 and ctl.observe(period, cy):
+            drain_queue()
+            program = get_mesh_program(problem, mesh, m, M, ctl.K, rounds,
+                                       T, capacity)
+            ev.emit("k_resize", host=me, args={"K": program.K})
+            last_ready = time.monotonic()
+            total = int(sizes.sum())
         # Idle = this host's mesh cannot run another chunk cycle anywhere.
         idle = int(sizes.max()) < m
         if max_steps is not None and steps >= max_steps:
@@ -360,6 +411,7 @@ def _host_loop(
             time.sleep(exchange_sleep_s)
 
     # -- phase 3: local residual drain --------------------------------------
+    drain_queue()  # remaining speculative dispatches are no-ops by now
     batch = program.residual_batch(state)
     diagnostics.device_to_host += 1
     pool.reset_from(batch)
@@ -394,6 +446,11 @@ def _host_loop(
         # every host: same knob, same problem shape, same device platform).
         "compact": program.inner.compact,
         "compact_auto": program.inner.compact_auto,
+        # Pipeline/K the host loop ran with (host-local: adaptive K may
+        # land hosts on different ladder rungs).
+        "pipeline_depth": depth,
+        "k_resolved": program.K,
+        "k_auto": k_auto,
         # Host-local counter totals (not reduced — per-host telemetry).
         "obs": (
             {"device_counters": ctr_total} if ctr_total is not None else None
@@ -416,6 +473,9 @@ def _reduce(local: dict, coll) -> SearchResult:
         complete=bool(coll.allreduce_min(int(local["complete"]))),
         compact=local.get("compact"),
         compact_auto=local.get("compact_auto", False),
+        pipeline_depth=local.get("pipeline_depth", 1),
+        k_resolved=local.get("k_resolved"),
+        k_auto=local.get("k_auto", False),
         obs=local.get("obs"),
     )
 
@@ -426,7 +486,7 @@ def dist_mesh_search(
     problem: Problem,
     m: int = 25,
     M: int = 16384,
-    K: int = 16,
+    K: int | str = 16,
     rounds: int = 2,
     D: int | None = None,
     mp: int = 1,

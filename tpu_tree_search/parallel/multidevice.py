@@ -76,10 +76,19 @@ class PauseGate:
         self.paused = 0  # guarded-by: _cond
         self.want = False  # guarded-by: _cond
 
-    def poll(self) -> None:
+    def poll(self, flush=None) -> None:
+        """``flush``: called (outside the lock) before parking when a pause
+        is wanted — the pipelined workers consume their in-flight chunk
+        there, so the paused-pools union is still the exact frontier. A
+        worker that misses a just-raised ``want`` here simply finishes its
+        current iteration (children pushed, pending consumed next poll);
+        ``pause()`` keeps waiting until every live worker parks."""
         with self._cond:
             if not self.want:
                 return
+        if flush is not None:
+            flush()
+        with self._cond:
             self.paused += 1
             self._cond.notify_all()
             while self.want:
@@ -230,16 +239,44 @@ def _worker_loop(
 ):
     problem = w.problem
     idle_t0: float | None = None  # open idle span start (obs tracing)
+    pending = None  # (staged, count, dev_result, t_chunk) in-flight chunk
     try:
         off = DeviceOffloader(problem, w.device)
         w.diagnostics = off.diagnostics
         D = len(pools)
         chunk_buf = problem.empty_batch(M)
+
+        def consume_pending() -> None:
+            # Collect + prune/branch + push of the in-flight chunk (the
+            # async-overlap discipline of `device_search`, per worker:
+            # while this chunk evaluated on device, the host popped and
+            # staged the next one into the other staging buffer).
+            nonlocal pending
+            if pending is None:
+                return
+            staged, count, dev_result, t_chunk = pending
+            pending = None
+            results = off.collect(dev_result)
+            res = problem.generate_children(staged, count, results, w.best)
+            w.tree += res.tree_inc
+            w.sol += res.sol_inc
+            if res.best < w.best:
+                w.best = res.best
+                if shared is not None:
+                    w.best = shared.publish(w.best)
+                ev.emit("incumbent", wid=w.wid, host=host_id,
+                        args={"best": w.best})
+            w.pool.locked_push_back_bulk(res.children)
+            ev.complete("chunk", t_chunk, wid=w.wid, host=host_id,
+                        args={"count": count, "tree": res.tree_inc,
+                              "sol": res.sol_inc})
+
         while True:
             if gate is not None:
-                # Chunk boundary: nothing in flight — the checkpoint
-                # rendezvous point.
-                gate.poll()
+                # Chunk boundary: the checkpoint rendezvous point — the
+                # flush consumes any in-flight chunk first, so a paused
+                # worker holds nothing outside its pool.
+                gate.poll(flush=consume_pending)
             # Pre-mark BUSY: with an external idle sampler (the dist tier's
             # communicator thread) marking busy only *after* the pop would
             # open a window where a worker holds a chunk while looking idle.
@@ -255,22 +292,19 @@ def _worker_loop(
                 if shared is not None:
                     w.best = min(w.best, shared.read())
                 bucket = bucket_size(count, m, M)
-                snapshot = {k: v[:count].copy() for k, v in chunk_buf.items()}
-                dev_result = off.dispatch(snapshot, count, bucket, w.best)
-                results = off.collect(dev_result)
-                res = problem.generate_children(snapshot, count, results, w.best)
-                w.tree += res.tree_inc
-                w.sol += res.sol_inc
-                if res.best < w.best:
-                    w.best = res.best
-                    if shared is not None:
-                        w.best = shared.publish(w.best)
-                    ev.emit("incumbent", wid=w.wid, host=host_id,
-                            args={"best": w.best})
-                w.pool.locked_push_back_bulk(res.children)
-                ev.complete("chunk", t_chunk, wid=w.wid, host=host_id,
-                            args={"count": count, "tree": res.tree_inc,
-                                  "sol": res.sol_inc})
+                staged = off.stage(chunk_buf, count, bucket)
+                dev_result = off.dispatch_staged(
+                    staged, count, w.best, overlapped=pending is not None
+                )
+                nxt = (staged, count, dev_result, t_chunk)
+                consume_pending()
+                pending = nxt
+                continue
+            if pending is not None:
+                # Pool dry but a chunk is in flight: its children may
+                # refill the pool past m — never steal or go idle with
+                # work outstanding.
+                consume_pending()
                 continue
             # -- work stealing (`pfsp_multigpu_chpl.chpl:438-479`) ---------
             stolen = False

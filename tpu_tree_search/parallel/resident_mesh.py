@@ -361,20 +361,26 @@ class _MeshResidentProgram:
     def step(self, state):
         return self._step(*state)
 
-    def read_stats(self, out):
-        """(state, tree, sol, cycles, sizes, best, tree_vec, ctr) — ``ctr``
-        is the harvested (D, NSLOTS) counter block when device counters are
-        on, else None (same dispatch-boundary readback as the scalars)."""
+    def carry(self, out):
+        """The dispatch's carried state ``(pool_vals, pool_aux, size,
+        best)`` — the next dispatch's input. Nothing is forced, so a
+        speculative dispatch can chain on it while still in flight."""
+        return tuple(out[:4])
+
+    def read_scalars(self, out):
+        """Blocks on the small per-shard outputs only — returns
+        ``(tree, sol, cycles, sizes, best, tree_vec, ctr)``. The donated
+        pool leaves (``out[0:2]``) are never touched: under pipelined
+        dispatch they were already donated into the next speculative
+        dispatch. ``sizes``/``best`` are (D,) vectors carried outside the
+        donation set."""
         if self.inner.obs:
-            *state, tree, sol, cycles, ctr = out
-            ctr = np.asarray(ctr)
+            tree, sol, cycles, ctr = out[4], out[5], out[6], np.asarray(out[7])
         else:
-            *state, tree, sol, cycles = out
-            ctr = None
-        sizes = np.asarray(state[2])
-        best = int(np.asarray(state[3]).min())
+            tree, sol, cycles, ctr = out[4], out[5], out[6], None
+        sizes = np.asarray(out[2])
+        best = int(np.asarray(out[3]).min())
         return (
-            tuple(state),
             int(np.asarray(tree).sum()),
             int(np.asarray(sol).sum()),
             int(np.asarray(cycles).sum()),
@@ -383,6 +389,13 @@ class _MeshResidentProgram:
             np.asarray(tree),
             ctr,
         )
+
+    def read_stats(self, out):
+        """(state, tree, sol, cycles, sizes, best, tree_vec, ctr) — the
+        synchronous read (carry + scalars); ``ctr`` is the harvested
+        (D, NSLOTS) counter block when device counters are on, else None
+        (same dispatch-boundary readback as the scalars)."""
+        return (self.carry(out),) + self.read_scalars(out)
 
     def residual_batch(self, state) -> dict:
         pool_vals, pool_aux, size, _ = state
@@ -410,11 +423,38 @@ class _MeshResidentProgram:
         return self.inner.derive_fields(batch)
 
 
+def get_mesh_program(problem, mesh, m: int, M: int, K: int, rounds: int,
+                     T: int, capacity: int) -> _MeshResidentProgram:
+    """The one per-problem cache of compiled SPMD mesh programs (a rebuild
+    costs ~30s on TPU), shared by the mesh and dist_mesh tiers. Keys carry
+    the env-dependent kernel-routing decisions (`routing_cache_token`) and
+    the obs state, so a knob flip rebuilds instead of silently reusing a
+    stale step — and the adaptive-K ladder (TTS_K=auto) resolves each rung
+    through this cache, so re-selecting a rung is a hit, not a recompile."""
+    cache = getattr(problem, "_mesh_programs", None)
+    if cache is None:
+        cache = problem._mesh_programs = {}
+    from ..ops.pfsp_device import routing_cache_token
+
+    key = (
+        tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
+        m, M, K, rounds, T, capacity,
+        routing_cache_token(problem, mesh.devices.flat[0]),
+        obs_counters.device_counters_enabled(),
+    )
+    program = cache.get(key)
+    if program is None:
+        program = cache[key] = _MeshResidentProgram(
+            problem, mesh, m, M, K, rounds, T, capacity
+        )
+    return program
+
+
 def mesh_resident_search(
     problem: Problem,
     m: int = 25,
     M: int = 16384,
-    K: int = 16,
+    K: int | str = 16,
     rounds: int = 2,
     T: int | None = None,
     capacity: int | None = None,
@@ -435,7 +475,10 @@ def mesh_resident_search(
     ``resident_search`` (a mesh snapshot merges every shard's frontier, and a
     resumed frontier re-partitions stride-D, so D may change across runs).
     ``guard``/TTS_GUARD=1 asserts zero recompiles + zero implicit transfers
-    per steady-state dispatch, exactly as in ``resident_search``."""
+    per steady-state dispatch, exactly as in ``resident_search``. Dispatch
+    is pipelined (TTS_PIPELINE) and ``K="auto"``/TTS_K=auto enables the
+    adaptive ladder with the tighter mesh target band — see
+    ``resident_search`` and engine/pipeline.py."""
     import jax
     from jax.sharding import Mesh
 
@@ -491,27 +534,22 @@ def mesh_resident_search(
     ev.counter("explored", tree=tree1, sol=sol1, phase=1)
 
     # -- phase 2: SPMD resident loop ---------------------------------------
-    # Cache the compiled SPMD program on the problem (recompiling the
-    # shard_map'd while-loop costs ~30s on TPU, cf. _make_program).
-    cache = getattr(problem, "_mesh_programs", None)
-    if cache is None:
-        cache = problem._mesh_programs = {}
-    # Key the env-dependent kernel-routing decisions exactly like
-    # _make_program does (a knob flip between searches must rebuild, not
-    # reuse the stale step) — one shared token definition.
-    from ..ops.pfsp_device import routing_cache_token
-
-    key = (
-        tuple(id(d) for d in mesh.devices.flat), mesh.devices.shape,
-        m, M, K, rounds, T, capacity,
-        routing_cache_token(problem, mesh.devices.flat[0]),
-        obs_counters.device_counters_enabled(),
+    from ..engine.pipeline import (
+        AdaptiveK,
+        DispatchQueue,
+        MESH_TARGET,
+        resolve_k,
+        resolve_pipeline_depth,
     )
-    program = cache.get(key)
-    if program is None:
-        program = cache[key] = _MeshResidentProgram(
-            problem, mesh, m, M, K, rounds, T, capacity
-        )
+
+    k_auto, k_value = resolve_k(K, default_max=16)
+    # The mesh tier's K is bounded by balancing responsiveness: incumbent
+    # pmin folds and diffusion rounds happen per dispatch, so the ladder
+    # targets a tighter host period than the single-device tier.
+    ctl = AdaptiveK(k_value, target=MESH_TARGET) if k_auto else None
+    depth = resolve_pipeline_depth()
+    program = get_mesh_program(problem, mesh, m, M,
+                               ctl.K if ctl else k_value, rounds, T, capacity)
 
     def upload(warm_batch):
         # Static stride-D partition (`nqueens_multigpu_chpl.chpl:221-225`).
@@ -525,39 +563,45 @@ def mesh_resident_search(
     tree2 = 0
     sol2 = 0
     per_worker = np.zeros(D, dtype=np.int64)
+    sizes = np.zeros(D, dtype=np.int32)
     prev_sizes = None
     offloader = None
 
-    def snapshot_fn():
-        batch = program.full_batch(state)
-        diagnostics.device_to_host += 1
-        return batch, best
-
-    controller = ckpt.RunController(
-        problem, checkpoint_path, checkpoint_interval_s, max_steps, snapshot_fn
-    )
-
     from ..analysis.guard import SteadyStateGuard, guard_enabled
 
-    sguard = SteadyStateGuard(
-        program._step, "mesh-resident step", enabled=guard_enabled(guard)
-    )
+    genabled = guard_enabled(guard)
+    guards: dict[int, SteadyStateGuard] = {}
+
+    def guard_of(prog) -> SteadyStateGuard:
+        g = guards.get(id(prog))
+        if g is None:
+            g = guards[id(prog)] = SteadyStateGuard(
+                prog._step, "mesh-resident step", enabled=genabled
+            )
+        return g
 
     ctr_total: dict | None = None
     fb_tree = fb_sol = 0  # saturation-fallback host increments (obs parity)
     prev_best = best
+    queue = DispatchQueue(depth)
 
     def obs_result() -> dict | None:
         return (
             {"device_counters": ctr_total} if ctr_total is not None else None
         )
 
-    while True:
-        t_disp = ev.now_us()
-        with sguard.step():
+    def enqueue() -> None:
+        nonlocal state
+        t_enq = ev.now_us()
+        with guard_of(program).step():
             out = program.step(state)
-        state, ti, si, cy, sizes, best, tree_vec, ctr = \
-            program.read_stats(out)
+        state = program.carry(out)
+        queue.push(out, t_enq)
+
+    def consume(out, t_enq) -> tuple[int, int, int]:
+        nonlocal tree2, sol2, sizes, best, ctr_total, prev_best, per_worker
+        t_wait = ev.now_us()
+        ti, si, cy, sizes, best, tree_vec, ctr = program.read_scalars(out)
         tree2 += ti
         sol2 += si
         per_worker += tree_vec.astype(np.int64)
@@ -565,19 +609,57 @@ def mesh_resident_search(
         if ctr is not None:
             ctr_total = obs_counters.merge_host(ctr_total, ctr)
         if ev.enabled():
-            ev.complete("dispatch", t_disp, args={
-                "cycles": cy, "tree": ti, "sol": si,
-                "size": int(sizes.sum()), "best": best,
-                "shard_sizes": sizes.tolist(),
-            })
+            now = ev.now_us()
+            ev.emit("dispatch", ph="X", ts=t_enq,
+                    dur=max(0.0, now - t_enq), args={
+                        "cycles": cy, "tree": ti, "sol": si,
+                        "size": int(sizes.sum()), "best": best,
+                        "shard_sizes": sizes.tolist(),
+                        "enqueue_us": t_enq, "read_wait_us": now - t_wait,
+                        "pipeline_depth": depth,
+                    })
             if ctr is not None:
                 ev.counter("device_counters", **obs_counters.as_args(ctr))
             if best < prev_best:
                 ev.emit("incumbent", args={"best": best})
         prev_best = best
+        return ti, si, cy
+
+    def drain_queue() -> tuple[int, int]:
+        dt = ds = 0
+        for out, t_enq in queue.drain():
+            ti, si, _ = consume(out, t_enq)
+            dt += ti
+            ds += si
+        return dt, ds
+
+    def snapshot_fn():
+        batch = program.full_batch(state)
+        diagnostics.device_to_host += 1
+        return batch, best
+
+    controller = ckpt.RunController(
+        problem, checkpoint_path, checkpoint_interval_s, max_steps,
+        snapshot_fn, drain_fn=drain_queue,
+    )
+
+    ev.emit("pipeline", args={
+        "depth": depth, "K": program.K, "k_auto": k_auto, "tier": "mesh",
+    })
+    last_ready = time.monotonic()
+
+    while True:
+        while not queue.full:
+            enqueue()
+        out, t_enq = queue.pop()
+        ti, si, cy = consume(out, t_enq)
+        now = time.monotonic()
+        period, last_ready = now - last_ready, now
         if int(sizes.max()) < m:
+            drain_queue()  # speculative no-ops; state passes through
             break
         if controller.after_step(tree1 + tree2, sol1 + sol2):
+            drain_queue()  # no-op if the cutoff save already drained
             t2 = time.perf_counter()
             phases.append(PhaseStats(t2 - t1, tree2, sol2))
             ev.emit("checkpoint", args={"cutoff": True})
@@ -593,14 +675,28 @@ def mesh_resident_search(
                 complete=False,
                 compact=program.inner.compact,
                 compact_auto=program.inner.compact_auto,
+                pipeline_depth=depth,
+                k_resolved=program.K,
+                k_auto=k_auto,
                 obs=obs_result(),
             )
+        if ctl is not None and cy > 0 and ctl.observe(period, cy):
+            drain_queue()
+            program = get_mesh_program(problem, mesh, m, M, ctl.K, rounds,
+                                       T, capacity)
+            ev.emit("k_resize", args={"K": program.K})
+            last_ready = time.monotonic()
+            prev_sizes = None
+            if int(sizes.max()) < m:
+                break
+            continue
         if cy == 0 and prev_sizes is not None and np.array_equal(sizes, prev_sizes):
             # Saturation: no shard ran a cycle and balancing moved nothing.
             # Fall back to host offload cycles (same guarantee as the
             # single-device tier) until the frontier fits again.
             from ..engine.device import DeviceOffloader, bucket_size
 
+            drain_queue()  # saturated speculative dispatches are no-ops too
             t_fb = ev.now_us()
             fb_tree0, fb_sol0 = tree2, sol2
             pool.reset_from(program.full_batch(state))
@@ -631,7 +727,8 @@ def mesh_resident_search(
             pool.clear()
             diagnostics.host_to_device += 1
             # Sanctioned re-upload; next dispatch is a fresh warm one.
-            sguard.rearm()
+            guard_of(program).rearm()
+            last_ready = time.monotonic()
             fb_tree += tree2 - fb_tree0
             fb_sol += sol2 - fb_sol0
             ev.complete("overflow_fallback", t_fb, args={
@@ -663,5 +760,8 @@ def mesh_resident_search(
         per_worker_tree=per_worker.tolist(),
         compact=program.inner.compact,
         compact_auto=program.inner.compact_auto,
+        pipeline_depth=depth,
+        k_resolved=program.K,
+        k_auto=k_auto,
         obs=obs_result(),
     )
