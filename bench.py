@@ -202,6 +202,107 @@ def last_good() -> dict | None:
     except (OSError, ValueError):
         return None
 
+
+# Failure-provenance artifact (flush-as-you-go): three rounds produced an
+# EMPTY bench trajectory because the one JSON line only prints at the very
+# end and dead-tunnel sessions never got there. This file is rewritten
+# (atomic replace + fsync) after every stage, so whatever already ran is
+# on disk when the process dies — rc, per-stage/per-row status, and the
+# failure reason included. TTS_BENCH_PARTIAL overrides the path; =0
+# disables.
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_PARTIAL.json")
+
+
+class BenchPartial:
+    """Crash-durable per-stage bench status (see PARTIAL_PATH note)."""
+
+    def __init__(self, path: str | None = None):
+        raw = os.environ.get("TTS_BENCH_PARTIAL", "")
+        if raw == "0":
+            default = None
+        elif os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # CPU smoke (JAX_PLATFORMS=cpu pins, incl. the express e2e
+            # test) must not dirty the working tree — same policy as
+            # BENCH_TRACE.json; hardware runs keep the committed path.
+            import tempfile
+
+            default = os.path.join(tempfile.gettempdir(),
+                                   "BENCH_PARTIAL.json")
+        else:
+            default = PARTIAL_PATH
+        self.path = None if raw == "0" else (path or raw or default)
+        self.doc = {
+            "status": "running",
+            "rc": None,
+            "started": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
+            "commit": _git_head(),
+            "rows": [],
+        }
+        self._index: dict[str, int] = {}
+        self._prev_sigterm = None
+        self.write()
+
+    def stage(self, name: str, status: str = "ok", **info) -> None:
+        row = {"stage": name, "status": status, **info}
+        i = self._index.get(name)
+        if i is None:
+            self._index[name] = len(self.doc["rows"])
+            self.doc["rows"].append(row)
+        else:
+            self.doc["rows"][i] = row
+        self.write()
+
+    def rows_from_extras(self, extras: list[dict]) -> None:
+        for rec in extras:
+            name = rec.get("metric", "extra")
+            self.stage(
+                name,
+                "error" if "error" in rec else "ok",
+                **({"error": rec["error"]} if "error" in rec
+                   else {"value": rec.get("value")}),
+            )
+
+    def finish(self, rc: int, status: str = "complete") -> None:
+        self.doc["status"] = status
+        self.doc["rc"] = rc
+        self.write()
+
+    def write(self) -> None:
+        if self.path is None:
+            return
+        try:
+            self.doc["updated"] = time.strftime(
+                "%Y-%m-%d %H:%M:%S UTC", time.gmtime()
+            )
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.doc, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # provenance must never break the bench itself
+
+    def install_sigterm(self) -> None:
+        """SIGTERM (the driver's timeout kill) marks the partial before
+        the process dies with the honest signal status."""
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def _on_term(signum, frame):
+            self.finish(128 + signum, "killed: SIGTERM")
+            signal.signal(signum, self._prev_sigterm or signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            pass
+
 # lb1-family probe (lb1 + nqueens): these kernels are hardware-proven
 # (docs/HW_VALIDATION.md) and carry the HEADLINE metric. Probed separately
 # from lb2 so an lb2 compile hang/crash can never cost the lb1 Pallas path
@@ -927,6 +1028,16 @@ def run_config(problem, m: int, M: int):
 
 
 def main() -> int:
+    partial = BenchPartial()
+    partial.install_sigterm()
+    try:
+        return _main(partial)
+    except BaseException as e:  # noqa: BLE001 — provenance, then re-raise
+        partial.finish(1, f"crashed: {type(e).__name__}: {e}")
+        raise
+
+
+def _main(partial: BenchPartial) -> int:
     from tpu_tree_search.cli import enable_compile_cache
 
     enable_compile_cache()
@@ -938,7 +1049,10 @@ def main() -> int:
     # still produces the round's artifact; a completed full bench then
     # overwrites BENCH_LAST_GOOD.json with the better-configured number.
     express = os.environ.get("TTS_BENCH_EXPRESS", "0") == "1"
+    partial.stage("backend_alive", "running", express=express)
     alive, alive_err = backend_alive(120.0 if express else 240.0)
+    partial.stage("backend_alive", "ok" if alive else "error",
+                  **({} if alive else {"error": alive_err}))
     if not alive:
         err_record = {
             "metric": "pfsp_ta014_lb1_nodes_per_sec_per_chip",
@@ -955,6 +1069,8 @@ def main() -> int:
         }
         if (lg := last_good()) is not None:
             err_record["last_good"] = lg
+        partial.rows_from_extras(err_record["extra"])
+        partial.finish(1, f"backend_dead: {alive_err}")
         print(json.dumps(err_record))
         return 1
 
@@ -964,8 +1080,12 @@ def main() -> int:
         pallas_err = "express mode: probes skipped (jnp path)"
         lb2_err = staged_err = None
     else:
+        partial.stage("pallas_probe", "running")
         (pallas_ok, pallas_err, lb2_ok, lb2_err,
          staged_ok, staged_err) = probe_pallas()
+    partial.stage("pallas_probe", "ok" if pallas_ok else "fallback",
+                  pallas=pallas_ok, lb2=lb2_ok, staged=staged_ok,
+                  **({"error": pallas_err} if pallas_err else {}))
     if not pallas_ok:
         os.environ["TTS_PALLAS"] = "0"
     if pallas_ok and not lb2_ok:
@@ -986,7 +1106,19 @@ def main() -> int:
 
     on_tpu = jax.default_backend() == "tpu"
     record: dict = {}
-    extras: list[dict] = []
+
+    class _FlushingExtras(list):
+        # Every extra row lands in the partial the moment it is measured
+        # (flush-as-you-go): a timeout mid-extras keeps the finished rows.
+        def append(self, rec):
+            super().append(rec)
+            partial.rows_from_extras([rec])
+
+        def extend(self, recs):
+            for rec in recs:
+                self.append(rec)
+
+    extras: list[dict] = _FlushingExtras()
     try:
         prob_hl = PFSPProblem(inst=14, lb="lb1", ub=1)
     except Exception as e:  # noqa: BLE001 — the line must still print
@@ -1036,6 +1168,7 @@ def main() -> int:
     if _obs_prev is None:
         os.environ["TTS_OBS"] = "host"
     obs_events.reset()
+    partial.stage("headline", "running")
     try:
         # -- headline: PFSP ta014 lb1 --------------------------------------
         # A jnp demotion is scoped to THIS run: the lb2/nqueens extras have
@@ -1116,6 +1249,12 @@ def main() -> int:
             "parity": False,
             "error": f"{type(e).__name__}: {e}",
         }
+    partial.stage(
+        "headline",
+        "ok" if record.get("parity") else "error",
+        value=record.get("value"),
+        **({"error": record["error"]} if record.get("error") else {}),
+    )
     # Attach the headline trace artifact (never fatal): Perfetto-loadable
     # file next to the bench, summary riding the JSON line.
     hl_events = obs_events.drain()
@@ -1145,6 +1284,28 @@ def main() -> int:
         }
     except Exception:  # noqa: BLE001 — bookkeeping must not cost the line
         pass
+    # Cost-model capture from the same headline events (on-chip only — a
+    # CPU smoke fit would pace real controllers with nonsense): measured
+    # dispatch latency+bandwidth lands in COSTMODEL.json next to the
+    # bench, where TTS_COSTMODEL can arm it (docs/OBSERVABILITY.md).
+    if on_tpu:
+        try:
+            from tpu_tree_search.obs import costmodel as obs_costmodel
+
+            prob_cm = PFSPProblem(inst=14, lb="lb1", ub=1)
+            profile = obs_costmodel.build_profile(
+                hl_events, "tpu", "device-D1",
+                obs_costmodel.shape_class(prob_cm),
+            )
+            cm_path = os.path.join(os.path.dirname(LAST_GOOD_PATH),
+                                   "COSTMODEL.json")
+            obs_costmodel.save(cm_path, profile)
+            record["costmodel"] = {
+                "path": os.path.basename(cm_path),
+                "links": sorted(next(iter(profile.values()))["links"]),
+            }
+        except Exception:  # noqa: BLE001 — capture must not cost the line
+            pass
 
     # -- extras: ta014 lb2 + N-Queens N=15 (never fail the bench; express
     # mode skips them all and shares the finalization tail below) ----------
@@ -1170,8 +1331,11 @@ def main() -> int:
     record["extra"] = extras
     if on_tpu and record.get("parity") and record.get("value", 0) > 0:
         record_last_good(record)
+    rc = 0 if record.get("parity") else 1
+    partial.rows_from_extras(extras)
+    partial.finish(rc)
     print(json.dumps(record))
-    return 0 if record.get("parity") else 1
+    return rc
 
 
 def _published_rate_rows(extras: list, on_tpu: bool) -> None:

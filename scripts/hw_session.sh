@@ -8,12 +8,22 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== 1/8 backend liveness =="
+# Arm the flight recorder for EVERY stage (obs/flightrec.py): any stage
+# that dies on a dead tunnel or hung dispatch dumps a post-mortem trace
+# naming the last completed dispatch instead of leaving nothing — stage 7
+# banks whatever got dumped. (TTS_OBS stays per-stage: bench pins =host
+# itself; the CLI runs below pass --trace/--costmodel.)
+export TTS_FLIGHTREC="${TTS_FLIGHTREC:-/tmp/tts_flight}"
+# Tighter stall threshold than the 300s default: a session stage whose
+# dispatch goes quiet for 2 minutes is the dead-tunnel signature.
+export TTS_WATCHDOG_S="${TTS_WATCHDOG_S:-120}"
+
+echo "== 1/9 backend liveness =="
 if ! timeout 120 python -c "import jax; print(jax.devices())"; then
   echo "TPU unreachable — aborting hardware session"; exit 1
 fi
 
-echo "== 2/8 express bench (first on-chip number in the smallest window) =="
+echo "== 2/9 express bench (first on-chip number in the smallest window) =="
 set -o pipefail
 if TTS_BENCH_EXPRESS=1 timeout 600 python bench.py \
     | tee /tmp/tts_bench_express.json; then
@@ -22,7 +32,7 @@ else
   echo "EXPRESS BENCH FAILED"
 fi
 
-echo "== 3/8 bench (full; overwrites BENCH_LAST_GOOD.json on success) =="
+echo "== 3/9 bench (full; overwrites BENCH_LAST_GOOD.json on success) =="
 if timeout 3000 python bench.py | tee /tmp/tts_bench_line.json; then
   echo "BENCH OK"
 else
@@ -33,25 +43,42 @@ else
 fi
 set +o pipefail
 
-echo "== 4/8 Pallas smoke gate (hardware compiles + oracle parity) =="
+echo "== 4/9 Pallas smoke gate (hardware compiles + oracle parity) =="
 TTS_TPU_TESTS=1 timeout 3000 python -m pytest tests/test_tpu_smoke.py -v
 
-echo "== 5/8 warm AOT compile cache for the validation matrix =="
+echo "== 5/9 warm AOT compile cache for the validation matrix =="
 timeout 1200 python scripts/warm_cache.py || true
 
-echo "== 6/8 guard-safe telemetry smoke (traced headline run + tts report) =="
+echo "== 6/9 guard-safe telemetry smoke (traced headline run + tts report) =="
 # The obs acceptance run (docs/OBSERVABILITY.md): full counters + trace
 # under the steady-state guard — zero guard violations required — then the
-# report summarizer over the written trace.
+# report summarizer over the written trace. --costmodel banks the measured
+# dispatch latency+bandwidth fit into COSTMODEL.json (the controllers
+# resolve their K bands from it when TTS_COSTMODEL=COSTMODEL.json is set).
 if timeout 900 python -m tpu_tree_search.cli pfsp --inst 14 --tier device \
-    --trace /tmp/tts_headline_trace.json --guard; then
+    --trace /tmp/tts_headline_trace.json --costmodel COSTMODEL.json --guard; then
   timeout 120 python -m tpu_tree_search.cli report /tmp/tts_headline_trace.json \
     || echo "TTS REPORT FAILED"
 else
   echo "TRACED GUARDED RUN FAILED"
 fi
 
-echo "== 7/8 chunk-size sweeps (un-measured configs first) =="
+echo "== 7/9 post-mortem + cost-model banking =="
+# Bank whatever the flight recorder dumped (a stage above that died on a
+# dead tunnel or hung dispatch left a post-mortem naming its last
+# completed dispatch) and this session's measured-profile/provenance
+# artifacts, so even a half-dead session ends with a diagnosable record.
+for f in "$TTS_FLIGHTREC".trace.json "$TTS_FLIGHTREC".metrics.jsonl; do
+  if [ -f "$f" ]; then
+    cp "$f" . && echo "banked post-mortem: $(basename "$f")"
+    timeout 120 python -m tpu_tree_search.cli report "$f" \
+      || echo "POST-MORTEM REPORT FAILED"
+  fi
+done
+[ -f COSTMODEL.json ] && echo "COSTMODEL.json present (arm future runs with TTS_COSTMODEL=COSTMODEL.json)"
+[ -f BENCH_PARTIAL.json ] && echo "BENCH_PARTIAL.json present (per-stage bench provenance)"
+
+echo "== 8/9 chunk-size sweeps (un-measured configs first) =="
 # N-Queens chunk sweep (first ever, VERDICT r5 #2): the default knob is
 # TTS_COMPACT=auto now (dense shift path for N-Queens); the scatter pin is
 # the round-5 baseline — together these rows ARE the fused-vs-scatter A/B
@@ -81,7 +108,7 @@ TTS_COMPACT=search timeout 1200 python scripts/headline_tune.py --quick || true
 timeout 900 python scripts/cycle_profile.py --M 1024 || true
 timeout 900 python scripts/cycle_profile.py --M 65536 --cycles 16 || true
 
-echo "== 8/8 tile sweep (per-kernel compile/throughput; informational) =="
+echo "== 9/9 tile sweep (per-kernel compile/throughput; informational) =="
 # Full ta014 tables were measured in the round-5 session
 # (docs/HW_VALIDATION.md); re-run is cheap with a warm cache and catches
 # compile-time regressions.

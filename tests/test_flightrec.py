@@ -1,0 +1,316 @@
+"""Flight recorder + live monitor (obs/flightrec.py, obs/live.py):
+SIGTERM dump validity, ring-buffer bounds, disabled-mode cost, guard
+interaction, and the --obs-serve/tts watch HTTP surface."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_tree_search.obs import events, flightrec
+from tpu_tree_search.obs.flightrec import FlightRecorder
+from tpu_tree_search.problems import NQueensProblem
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    rec = flightrec.recorder()
+    period = rec._snap_period_us
+    flightrec.reset()
+    yield
+    rec._snap_period_us = period  # tests drop the rate limit; restore it
+    flightrec.reset()
+
+
+# -- enablement + ring bounds ------------------------------------------------
+
+
+def test_disabled_heartbeat_records_nothing(monkeypatch):
+    monkeypatch.delenv("TTS_OBS", raising=False)
+    monkeypatch.delenv("TTS_FLIGHTREC", raising=False)
+    assert not flightrec.enabled()
+    flightrec.heartbeat("resident", seq=1, cycles=2, size=10, best=5)
+    assert flightrec.latest() is None
+    assert flightrec.recorder().state()["last_dispatch"] == {}
+    # TTS_FLIGHTREC=0 force-disables even with obs on.
+    monkeypatch.setenv("TTS_OBS", "host")
+    monkeypatch.setenv("TTS_FLIGHTREC", "0")
+    assert not flightrec.enabled()
+    # An explicit prefix arms recording without TTS_OBS.
+    monkeypatch.delenv("TTS_OBS", raising=False)
+    monkeypatch.setenv("TTS_FLIGHTREC", "/tmp/x")
+    assert flightrec.enabled()
+    assert flightrec.dump_prefix() == "/tmp/x"
+
+
+def test_ring_buffer_bounded(monkeypatch):
+    monkeypatch.setenv("TTS_OBS", "host")
+    rec = FlightRecorder(ring=8, snapshot_period_us=0.0)
+    for i in range(100):
+        rec.heartbeat("resident", seq=i + 1, cycles=1, size=i,
+                      best=100, tree=i * 10, sol=0)
+    snaps = rec.snapshots()
+    assert len(snaps) == 8  # bounded: oldest aged out
+    assert snaps[-1]["seq"] == 100 and snaps[0]["seq"] >= 92
+    assert rec.latest()["tree"] == 990
+
+
+def test_snapshot_rate_limit_and_aggregation(monkeypatch):
+    monkeypatch.setenv("TTS_OBS", "host")
+    rec = FlightRecorder(snapshot_period_us=1e12)  # one snapshot ever
+    rec.heartbeat("multi", host=0, wid=0, seq=3, size=10, best=9,
+                  tree=100, sol=1, steals=2)
+    rec.heartbeat("multi", host=0, wid=1, seq=5, size=20, best=7,
+                  tree=50, sol=0, steals=1)
+    rec.set_idle(0, 1, True)
+    state = rec.state()
+    assert set(state["last_dispatch"]) == {"h0/w0", "h0/w1"}
+    assert state["idle_workers"] == ["h0/w1"]
+    # Only the first heartbeat could snapshot (rate limit).
+    assert len(rec.snapshots()) == 1
+    # A fresh recorder with no limit aggregates across workers.
+    rec2 = FlightRecorder(snapshot_period_us=0.0)
+    rec2.heartbeat("multi", wid=0, seq=1, size=10, best=9, tree=100,
+                   sol=1, steals=2)
+    rec2.heartbeat("multi", wid=1, seq=2, size=20, best=7, tree=50,
+                   sol=0, steals=1)
+    snap = rec2.latest()
+    assert snap["tree"] == 150 and snap["best"] == 7
+    assert snap["size"] == 30 and snap["steals"] == 3
+    assert snap["workers"] == 2
+
+
+def test_heartbeats_ride_resident_dispatch_boundaries(monkeypatch):
+    from tpu_tree_search.engine.resident import resident_search
+
+    monkeypatch.setenv("TTS_OBS", "host")
+    events.reset()
+    res = resident_search(NQueensProblem(N=9), m=8, M=128, K=4)
+    state = flightrec.recorder().state()
+    last = state["last_dispatch"]["h0/w0"]
+    # The registry names the last completed dispatch: the final one is the
+    # terminal (or drained speculative) dispatch of a finished search.
+    assert last["seq"] >= 2
+    assert last["tree"] + res.phases[0].tree + res.phases[2].tree \
+        == res.explored_tree
+    assert state["meta"]["tier"] == "resident"
+    # Rate-limited snapshot counter samples landed in the event stream.
+    names = {e["name"] for e in events.drain()}
+    assert "snapshot" in names
+
+
+# -- dump validity -----------------------------------------------------------
+
+
+def test_dump_writes_parseable_trace_and_metrics(tmp_path, monkeypatch):
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.obs.export import load_trace
+    from tpu_tree_search.obs.report import summarize
+
+    monkeypatch.setenv("TTS_OBS", "host")
+    events.reset()
+    resident_search(NQueensProblem(N=9), m=8, M=128, K=4)
+    prefix = str(tmp_path / "fr")
+    path = flightrec.dump("unit-test", prefix=prefix)
+    assert path == prefix + ".trace.json"
+    obj = json.loads((tmp_path / "fr.trace.json").read_text())
+    frd = obj["otherData"]["flightrec"]
+    assert frd["reason"] == "unit-test"
+    assert "h0/w0" in frd["last_dispatch"]
+    assert {"seq", "cycles", "size", "inflight"} <= set(
+        frd["last_dispatch"]["h0/w0"]
+    )
+    # The dump is a VALID trace: loadable + summarizable like any other.
+    evts = load_trace(str(tmp_path / "fr.trace.json"))
+    s = summarize(evts)
+    assert s["events"] > 0 and s["cycle_rate"]
+    lines = (tmp_path / "fr.metrics.jsonl").read_text().splitlines()
+    recs = [json.loads(ln) for ln in lines]
+    assert any(r.get("name") == "snapshot" for r in recs)
+
+
+def test_sigterm_mid_search_leaves_postmortem(tmp_path):
+    """The acceptance criterion: a CPU run killed mid-search (SIGTERM)
+    leaves a parseable Chrome-trace + metrics dump identifying the last
+    completed dispatch."""
+    prefix = str(tmp_path / "killed")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", TTS_OBS="host",
+        TTS_FLIGHTREC=prefix,
+    )
+    # N=15 runs for minutes on CPU — the kill always lands mid-search.
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_tree_search.cli", "nqueens",
+         "--N", "15", "--tier", "device", "--M", "4096", "--K", "16"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        time.sleep(20)  # past compile, into the dispatch loop
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert rc == -signal.SIGTERM  # honest death status preserved
+    obj = json.loads((tmp_path / "killed.trace.json").read_text())
+    frd = obj["otherData"]["flightrec"]
+    assert frd["reason"] == "SIGTERM"
+    last = frd["last_dispatch"]["h0/w0"]
+    assert last["seq"] >= 1 and last["tree"] > 0
+    assert "idle_workers" in frd and "meta" in frd
+    # tts report consumes the corpse like any trace (exit 0).
+    from tpu_tree_search import cli
+
+    assert cli.main(["report", prefix + ".trace.json",
+                     prefix + ".metrics.jsonl"]) == 0
+
+
+def test_dump_never_raises(tmp_path):
+    # Unwritable prefix: dump returns None instead of raising (a failed
+    # post-mortem must not change how the process dies).
+    assert flightrec.dump("x", prefix=str(tmp_path / "no/such/dir/p")) is None
+
+
+def test_excepthook_dumps_then_chains(tmp_path, monkeypatch):
+    monkeypatch.setenv("TTS_OBS", "host")
+    monkeypatch.setenv("TTS_FLIGHTREC", str(tmp_path / "exc"))
+    rec = FlightRecorder(snapshot_period_us=0.0)
+    rec.heartbeat("resident", seq=1, cycles=1, size=5, best=3, tree=10,
+                  sol=0)
+    called = {}
+    rec._prev_excepthook = lambda *a: called.setdefault("prev", a)
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        rec._on_exception(*sys.exc_info())
+    assert called["prev"][0] is ValueError
+    obj = json.loads((tmp_path / "exc.trace.json").read_text())
+    assert obj["otherData"]["flightrec"]["reason"].startswith(
+        "exception: ValueError"
+    )
+
+
+# -- guard + disabled-path interaction --------------------------------------
+
+
+def test_guarded_run_green_with_flightrec_armed(tmp_path, monkeypatch):
+    """TTS_GUARD=1 + TTS_OBS=1 + flight recording: heartbeats are pure
+    host bookkeeping at existing dispatch boundaries — zero recompiles,
+    zero implicit transfers, counts unchanged."""
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.engine.sequential import sequential_search
+
+    monkeypatch.setenv("TTS_OBS", "1")
+    monkeypatch.setenv("TTS_FLIGHTREC", str(tmp_path / "g"))
+    events.reset()
+    res = resident_search(NQueensProblem(N=9), m=8, M=128, K=4, guard=True)
+    seq = sequential_search(NQueensProblem(N=9))
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    assert flightrec.latest() is not None
+
+
+# -- live monitor (obs/live.py) ----------------------------------------------
+
+
+@pytest.fixture()
+def live_server(monkeypatch):
+    from tpu_tree_search.obs import live
+
+    monkeypatch.setenv("TTS_OBS", "host")
+    srv = live.serve(0)  # ephemeral port
+    yield srv
+    srv.close()
+
+
+def _feed(n: int = 3):
+    rec = flightrec.recorder()
+    for i in range(n):
+        rec.heartbeat("resident", seq=i + 1, cycles=4, size=100 + i,
+                      best=1377, tree=1000 * (i + 1), sol=3, depth=2, K=16)
+
+
+def test_live_endpoints(live_server):
+    from urllib.request import urlopen
+
+    base = live_server.url
+    with urlopen(base + "/snapshot", timeout=5) as r:
+        assert json.loads(r.read()) == {}  # before any heartbeat
+    flightrec.recorder()._snap_period_us = 0.0
+    _feed(3)
+    with urlopen(base + "/snapshot", timeout=5) as r:
+        snap = json.loads(r.read())
+    assert snap["seq"] == 3 and snap["best"] == 1377 and snap["K"] == 16
+    with urlopen(base + "/snapshots?n=2", timeout=5) as r:
+        assert len(json.loads(r.read())) == 2
+    with urlopen(base + "/state", timeout=5) as r:
+        state = json.loads(r.read())
+    assert "h0/w0" in state["last_dispatch"]
+    with urlopen(base + "/healthz", timeout=5) as r:
+        assert json.loads(r.read()) == {"ok": True}
+
+
+def test_live_sse_stream_and_watch(live_server, capsys):
+    from urllib.request import urlopen
+
+    from tpu_tree_search.obs.live import format_snapshot, watch_main
+
+    flightrec.recorder()._snap_period_us = 0.0
+    _feed(2)
+    with urlopen(live_server.url + "/stream", timeout=10) as resp:
+        snap = None
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                snap = json.loads(line[6:])
+                break
+    assert snap is not None and snap["seq"] == 2
+    # The watch client renders the streamed snapshot.
+    assert watch_main(live_server.port, once=True) == 0
+    out = capsys.readouterr().out
+    assert "best=1377" in out and "K=16" in out
+    assert watch_main(live_server.port, max_updates=1, as_json=True) == 0
+    streamed = json.loads(capsys.readouterr().out.strip())
+    assert streamed["seq"] == 2
+    line = format_snapshot(snap)
+    assert "nodes/s" in line and "dispatch#2" in line
+
+
+def test_watch_unreachable_exits_2(capsys):
+    from tpu_tree_search.obs.live import watch_main
+
+    # A closed ephemeral port: grab one, close it, then watch it.
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    assert watch_main(port, once=True) == 2
+    assert "no live monitor" in capsys.readouterr().err
+
+
+def test_cli_obs_serve_flag(monkeypatch, capsys):
+    """--obs-serve runs a search with the monitor up and implies TTS_OBS;
+    the search result is unchanged."""
+    from tpu_tree_search import cli
+
+    monkeypatch.delenv("TTS_OBS", raising=False)
+    # Port 0 => ephemeral: proves the flag path end to end without racing
+    # a fixed port against parallel CI jobs.
+    assert cli.main([
+        "nqueens", "--N", "8", "--tier", "device", "--m", "5", "--M", "64",
+        "--obs-serve", "0", "--json",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "Live monitor: http://127.0.0.1:" in out
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["explored_sol"] == 92

@@ -198,6 +198,51 @@ def test_report_json_and_missing_file(tmp_path, capsys):
     assert {"steal", "idle", "cycle_rate", "events"} <= set(summary)
 
 
+def test_report_truncated_trace_salvages_events(tmp_path, capsys):
+    """Robustness contract: a killed writer's truncated trace is
+    summarized as far as it parses — exit 0 with a warning."""
+    trace = tmp_path / "t.json"
+    with capture(trace_path=str(trace)):
+        from tpu_tree_search.engine.resident import resident_search
+
+        resident_search(NQueensProblem(N=9), m=8, M=128, K=4)
+    full = trace.read_text()
+    (tmp_path / "cut.json").write_text(full[: int(len(full) * 0.6)])
+    assert cli.main(["report", str(tmp_path / "cut.json")]) == 0
+    captured = capsys.readouterr()
+    assert "salvaged" in captured.err
+    assert "cycle-rate timeline" in captured.out
+
+
+def test_report_empty_and_garbage_files_exit_zero(tmp_path, capsys):
+    (tmp_path / "empty.json").write_text("")
+    (tmp_path / "junk.json").write_text("not a trace at all")
+    assert cli.main(["report", str(tmp_path / "empty.json"),
+                     str(tmp_path / "junk.json")]) == 0
+    captured = capsys.readouterr()
+    assert "Warning" in captured.err
+    assert "steal efficiency" in captured.out  # full report shape, zeros
+
+
+def test_report_merges_multiple_metrics_files(tmp_path, capsys):
+    """Multi-worker sessions write one metrics file per host; the report
+    merges any mix of traces and metrics JSONL into one summary."""
+    m1 = tmp_path / "h0.jsonl"
+    m2 = tmp_path / "h1.jsonl"
+    m1.write_text(json.dumps(
+        {"ts_us": 10.0, "name": "explored", "host": 0, "worker": 0,
+         "tree": 100, "sol": 2, "phase": 2}) + "\n")
+    m2.write_text(json.dumps(
+        {"ts_us": 12.0, "name": "explored", "host": 1, "worker": 0,
+         "tree": 50, "sol": 1, "phase": 2}) + "\n"
+        + "{torn line")  # mid-write kill tail: skipped, not fatal
+    assert cli.main(["report", str(m1), str(m2), "--json"]) == 0
+    captured = capsys.readouterr()
+    summary = json.loads(captured.out)
+    assert summary["events"] == 2
+    assert summary["hosts"] == 2
+
+
 def test_multi_trace_records_steals_and_idle(tmp_path):
     import jax
 

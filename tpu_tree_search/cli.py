@@ -137,6 +137,23 @@ def build_parser() -> argparse.ArgumentParser:
                         help="append one JSON line per telemetry counter "
                         "sample to this file (scrape-ready); implies "
                         "TTS_OBS=1 unless TTS_OBS is already set")
+    common.add_argument("--obs-serve", type=int, default=None,
+                        metavar="PORT",
+                        help="serve live run snapshots (nodes/s, incumbent, "
+                        "pool occupancy, pipeline depth/K) on "
+                        "127.0.0.1:PORT over HTTP/SSE; watch with "
+                        "`tts watch --port PORT`; implies TTS_OBS=1 "
+                        "unless TTS_OBS is already set "
+                        "(docs/OBSERVABILITY.md)")
+    common.add_argument("--costmodel", type=str, default=None,
+                        metavar="PATH",
+                        help="after the run, fit per-link-class "
+                        "latency+bandwidth profiles from the recorded "
+                        "spans and merge them into this COSTMODEL.json "
+                        "(keyed by backend/topology/problem shape); "
+                        "TTS_COSTMODEL=PATH makes later runs resolve "
+                        "their K bands from it; implies TTS_OBS=1 unless "
+                        "TTS_OBS is already set")
     common.add_argument("--guard", action="store_true",
                         help="resident tiers: assert every steady-state "
                         "device dispatch performs zero recompilations and "
@@ -181,9 +198,28 @@ def build_parser() -> argparse.ArgumentParser:
         help="summarize a --trace file: steal efficiency, idle fraction "
         "per worker, cycle-rate timeline (docs/OBSERVABILITY.md)",
     )
-    rep.add_argument("trace", help="trace file written by --trace")
+    rep.add_argument("trace", nargs="+",
+                     help="trace/metrics/flight-recorder files (merged "
+                     "into one report; truncated or empty files are "
+                     "summarized as far as they parse)")
     rep.add_argument("--json", action="store_true", dest="report_json",
                      help="emit the summary as one JSON object")
+
+    watch = sub.add_parser(
+        "watch",
+        help="live view of a run started with --obs-serve PORT: one "
+        "status line per snapshot (nodes/s, incumbent, pool occupancy, "
+        "pipeline depth/K)",
+    )
+    watch.add_argument("--port", type=int, default=8642,
+                       help="the --obs-serve port (default 8642)")
+    watch.add_argument("--host", type=str, default="127.0.0.1")
+    watch.add_argument("--interval", type=float, default=1.0,
+                       help="polling fallback interval in seconds")
+    watch.add_argument("--once", action="store_true",
+                       help="print the current snapshot and exit")
+    watch.add_argument("--json", action="store_true", dest="watch_json",
+                       help="emit raw snapshot JSON lines")
     return p
 
 
@@ -351,11 +387,13 @@ def run_tier(problem, args):
     if args.guard:
         pins["TTS_GUARD"] = "1"
     if (
-        (args.trace is not None or args.metrics_file is not None)
+        (args.trace is not None or args.metrics_file is not None
+         or args.obs_serve is not None or args.costmodel is not None)
         and "TTS_OBS" not in os.environ
     ):
-        # --trace/--metrics-file turn telemetry on for the run; an explicit
-        # TTS_OBS (e.g. =host to keep device programs untouched) wins.
+        # --trace/--metrics-file/--obs-serve/--costmodel turn telemetry on
+        # for the run; an explicit TTS_OBS (e.g. =host to keep device
+        # programs untouched) wins.
         pins["TTS_OBS"] = "1"
     if not pins:
         return _dispatch_tier(problem, args)
@@ -641,6 +679,39 @@ def result_record(args, res) -> dict:
     return rec
 
 
+def run_topology(args) -> str:
+    """The profile-key topology string of this run (obs/costmodel.py):
+    mirrors what the engines pass to ``resolve_target_band`` so a capture
+    from tier X exactly matches a later run of tier X."""
+    if args.tier in ("seq", "device"):
+        return "device-D1"
+    D = args.D if args.D is not None else 0  # 0 = "all local devices"
+    if args.tier == "mesh":
+        return f"mesh-D{D}" if D else "mesh-Dall"
+    if args.tier == "dist_mesh":
+        H = args.hosts or 1
+        return f"dist_mesh-H{H}xD{D}" if D else f"dist_mesh-H{H}xDall"
+    H = args.hosts or 1
+    return f"{args.tier}-H{H}xD{D}" if D else f"{args.tier}-H{H}xDall"
+
+
+def write_costmodel(args, problem, evts, path, cm) -> tuple[str, dict]:
+    """Fit + merge this run's profile entry into ``path`` (the
+    ``--costmodel`` capture). Returns the entry's (key, value)."""
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — capture must not fail the run
+        backend = "cpu"
+    profile = cm.build_profile(
+        evts, backend, run_topology(args), cm.shape_class(problem)
+    )
+    cm.save(path, profile)
+    key = next(iter(profile))
+    return key, profile[key]
+
+
 def enable_compile_cache() -> None:
     """Persist XLA/Mosaic executables across processes (the resident tiers
     compile ~30s while-loop programs, and large-instance Mosaic compiles
@@ -695,6 +766,13 @@ def main(argv=None) -> int:
         from .obs.report import report_main
 
         return report_main(args.trace, as_json=args.report_json)
+    if args.problem == "watch":
+        # Pure HTTP client of a --obs-serve run: no jax import.
+        from .obs.live import watch_main
+
+        return watch_main(args.port, host=args.host,
+                          interval=args.interval, once=args.once,
+                          as_json=args.watch_json)
     validate_args(parser, args)
     primary = True
     if args.distributed:
@@ -735,10 +813,26 @@ def main(argv=None) -> int:
         print_settings(args)
     from .obs import events as obs_events
 
-    if args.trace or args.metrics_file or obs_events.enabled():
+    wants_obs = (args.trace or args.metrics_file or args.costmodel
+                 or args.obs_serve is not None)
+    if wants_obs or obs_events.enabled():
         # Run-scoped telemetry: a prior run's events in this process must
         # not leak into this run's trace.
         obs_events.reset()
+        # Arm the flight recorder from the MAIN thread (signal handlers
+        # only attach here; engines re-arm the watchdog per run). With
+        # TTS_OBS off and TTS_FLIGHTREC unset this is a no-op.
+        from .obs import flightrec
+
+        flightrec.reset()
+        flightrec.recorder().install()
+    live_server = None
+    if args.obs_serve is not None and primary:
+        from .obs import live as obs_live
+
+        live_server = obs_live.serve(args.obs_serve)
+        print(f"Live monitor: {live_server.url} "
+              f"(tts watch --port {live_server.port})")
     try:
         if args.profile:
             # Trace the whole search (phase timers remain the first-class
@@ -752,13 +846,16 @@ def main(argv=None) -> int:
     except (ModuleNotFoundError, NotImplementedError) as e:
         print(f"Error: tier '{args.tier}' unavailable: {e}", file=sys.stderr)
         return 2
+    finally:
+        if live_server is not None:
+            live_server.close()
     # Multi-process pods: every host computed the same reduced result;
     # report from process 0 only (the MPI baseline's rank-0 stats line,
     # `pfsp_dist_multigpu_cuda.c:179-187`).
     if primary:
         print_results(args, problem, res)
         rec = result_record(args, res)
-        if args.trace or args.metrics_file:
+        if args.trace or args.metrics_file or args.costmodel:
             from .obs import export as obs_export
 
             evts = obs_events.drain()
@@ -768,6 +865,16 @@ def main(argv=None) -> int:
                       "open in Perfetto or `tts report`)")
             if args.metrics_file:
                 obs_export.write_metrics_jsonl(evts, args.metrics_file)
+            if args.costmodel:
+                from .obs import costmodel as obs_costmodel
+
+                key, entry = write_costmodel(
+                    args, problem, evts, args.costmodel, obs_costmodel
+                )
+                links = ", ".join(sorted(entry["links"])) or "none"
+                print(f"Cost model written: {args.costmodel} [{key}] "
+                      f"(links: {links}; arm with TTS_COSTMODEL="
+                      f"{args.costmodel})")
         if args.json:
             print(json.dumps(rec))
         if args.stats_file:

@@ -224,6 +224,9 @@ def device_search(
     pool.push_back(index_batch(problem.root(), 0))
     off = DeviceOffloader(problem, device)
 
+    from ..obs import flightrec as fr
+
+    fr.arm("offload")
     phases: list[PhaseStats] = []
     t0 = time.perf_counter()
 
@@ -239,8 +242,10 @@ def device_search(
     chunk_buf = problem.empty_batch(M)
     pending = None  # (staged_buffer, count, device_result)
 
+    n_chunk = 0  # completed-chunk sequence (flight-recorder registry)
+
     def consume(p):
-        nonlocal tree2, sol2, best
+        nonlocal tree2, sol2, best, n_chunk
         parents_np, count, dev_result = p
         results = off.collect(dev_result)
         res = problem.generate_children(parents_np, count, results, best)
@@ -248,6 +253,9 @@ def device_search(
         sol2 += res.sol_inc
         best = res.best
         pool.push_back_bulk(res.children)
+        n_chunk += 1
+        fr.heartbeat("offload", seq=n_chunk, size=pool.size, best=best,
+                     tree=tree2, sol=sol2)
 
     while True:
         count = pool.pop_back_bulk(m, M, chunk_buf)

@@ -64,6 +64,50 @@ RESIDENT_TARGET = (0.100, 0.250)
 MESH_TARGET = (0.050, 0.150)
 
 
+def resolve_target_band(
+    tier: str,
+    default: tuple[float, float],
+    problem=None,
+    topology: str = "",
+) -> tuple[tuple[float, float], str | None]:
+    """The AdaptiveK target band for one run: ``(band, source)``.
+
+    With ``TTS_COSTMODEL=<profile>`` set and a usable entry in it, the
+    band derives from the profile's MEASURED per-dispatch latency fit
+    (obs/costmodel.py — the arXiv:1904.06825 latency+bandwidth model);
+    otherwise ``default`` (the documented fixed band) with source None.
+    Because the mesh/dist tiers fold incumbents, run diffusion rounds,
+    and exchange at dispatch boundaries, this band IS their steal and
+    exchange period — resolving it from the profile paces those too.
+
+    A band only moves K along the existing geometric ladder: search
+    results stay bit-identical to the fixed-band fallback by construction
+    (tests/test_costmodel.py pins it).
+    """
+    path_env = os.environ.get("TTS_COSTMODEL", "") or ""
+    if path_env in ("", "0"):
+        return default, None
+    from ..obs import costmodel as cm
+
+    profile = cm.load(path_env)
+    if not profile:
+        return default, None
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — band resolution must never fail a run
+        backend = "cpu"
+    hit = cm.lookup(profile, backend, topology, cm.shape_class(problem))
+    if hit is None:
+        return default, None
+    key, entry = hit
+    band = cm.resolve_band(entry, tier)
+    if band is None:
+        return default, None
+    return band, key
+
+
 def pipeline_mode() -> str:
     """The raw ``TTS_PIPELINE`` knob (``auto`` default)."""
     return os.environ.get("TTS_PIPELINE", "auto") or "auto"

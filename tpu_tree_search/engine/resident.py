@@ -47,6 +47,7 @@ import numpy as np
 
 from ..obs import counters as obs_counters
 from ..obs import events as ev
+from ..obs import flightrec as fr
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem
 from ..problems.nqueens import NQueensProblem
@@ -706,10 +707,16 @@ def resident_search(
         RESIDENT_TARGET,
         resolve_k,
         resolve_pipeline_depth,
+        resolve_target_band,
     )
 
     k_auto, k_value = resolve_k(K, default_max=4096)
-    ctl = AdaptiveK(k_value, target=RESIDENT_TARGET) if k_auto else None
+    # TTS_COSTMODEL: a measured-profile band replaces the fixed target
+    # (engine/pipeline.py resolve_target_band; fixed band is the fallback).
+    band, band_src = resolve_target_band(
+        "resident", RESIDENT_TARGET, problem, topology="device-D1"
+    )
+    ctl = AdaptiveK(k_value, target=band) if k_auto else None
     depth = resolve_pipeline_depth()
     program = _make_program(problem, m, M, ctl.K if ctl else k_value,
                             capacity, device)
@@ -740,6 +747,7 @@ def resident_search(
     ctr_total: dict | None = None
     fb_tree = fb_sol = 0  # overflow-fallback host increments (obs parity)
     prev_best = best
+    n_disp = 0  # completed-dispatch sequence (flight-recorder registry)
     queue = DispatchQueue(depth)
 
     def obs_result() -> dict | None:
@@ -761,15 +769,19 @@ def resident_search(
         queue.push(out, t_enq)
 
     def consume(out, t_enq) -> tuple[int, int, int]:
-        nonlocal tree2, sol2, size, best, ctr_total, prev_best
+        nonlocal tree2, sol2, size, best, ctr_total, prev_best, n_disp
         t_wait = ev.now_us()
         tree_inc, sol_inc, cycles, size, best, ctr = \
             program.read_scalars(out)
         tree2 += tree_inc
         sol2 += sol_inc
+        n_disp += 1
         diagnostics.kernel_launches += cycles
         if ctr is not None:
             ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        fr.heartbeat("resident", seq=n_disp, cycles=cycles, size=size,
+                     best=best, tree=tree2, sol=sol2, depth=depth,
+                     K=program.K, inflight=len(queue))
         if ev.enabled():
             now = ev.now_us()
             # Span semantics under pipelining (docs/OBSERVABILITY.md): the
@@ -811,9 +823,15 @@ def resident_search(
         snapshot_fn, drain_fn=drain_queue,
     )
 
+    fr.arm("resident")
     ev.emit("pipeline", args={
         "depth": depth, "K": program.K, "k_auto": k_auto, "tier": "resident",
     })
+    if band_src is not None:
+        ev.emit("costmodel", args={
+            "source": band_src, "lo_ms": round(1e3 * band[0], 1),
+            "hi_ms": round(1e3 * band[1], 1), "tier": "resident",
+        })
     last_ready = time.monotonic()
 
     while True:

@@ -13,6 +13,17 @@ Three legs (see docs/OBSERVABILITY.md):
     JSON lines for scraping, and the ``tts report`` summarizer (steal
     efficiency, idle fraction per worker, cycle-rate timeline).
 
+Closed-loop legs (same doc):
+
+  * ``flightrec`` — crash-safe flight recorder: snapshot ring +
+    last-dispatch registry, dumped as a valid trace on SIGTERM/SIGALRM/
+    exception/watchdog stall (``TTS_FLIGHTREC``).
+  * ``live`` — ``--obs-serve`` localhost HTTP/SSE snapshot streaming and
+    the ``tts watch`` client.
+  * ``costmodel`` — measured per-link latency+bandwidth profiles
+    (``COSTMODEL.json``) that AdaptiveK and the mesh/dist periods resolve
+    from (``TTS_COSTMODEL``).
+
 Knobs: ``TTS_OBS=1`` (everything), ``TTS_OBS=host`` (host events only —
 device programs untouched), off by default with zero hot-loop cost.
 ``--trace out.json`` / ``--metrics-file m.jsonl`` on every CLI tier.
@@ -23,13 +34,16 @@ from __future__ import annotations
 import os
 from contextlib import contextmanager
 
-from . import counters, events, export, report
+from . import costmodel, counters, events, export, flightrec, live, report
 
 __all__ = [
     "capture",
+    "costmodel",
     "counters",
     "events",
     "export",
+    "flightrec",
+    "live",
     "obs_enabled",
     "report",
 ]

@@ -83,10 +83,25 @@ class EventRecorder:
     def emit(self, event: dict) -> None:
         self._buf().append(event)
 
-    def drain(self) -> list[dict]:
-        """Merged, time-sorted snapshot of every thread's buffer."""
-        with self._lock:
-            merged = [e for buf in self._buffers for e in list(buf)]
+    def drain(self, timeout: float | None = None) -> list[dict]:
+        """Merged, time-sorted snapshot of every thread's buffer.
+
+        ``timeout`` bounds the registry-lock wait (the flight recorder
+        drains from signal handlers and its watchdog thread — the
+        interrupted thread could hold the lock mid-registration); on a
+        timeout the merge proceeds best-effort without the lock (deque
+        iteration is safe against concurrent appends; at worst a buffer
+        registered this instant is missed)."""
+        locked = (
+            self._lock.acquire() if timeout is None
+            else self._lock.acquire(timeout=timeout)
+        )
+        try:
+            # tts-lint: waive guarded-by -- lock-timeout fallback for signal-handler drains: deque iteration over a list() copy is safe vs concurrent appends; a just-registered buffer may be missed
+            merged = [e for buf in list(self._buffers) for e in list(buf)]
+        finally:
+            if locked:
+                self._lock.release()
         merged.sort(key=lambda e: e.get("ts", 0.0))
         return merged
 
@@ -109,8 +124,8 @@ def reset() -> None:
     _recorder.clear()
 
 
-def drain() -> list[dict]:
-    return _recorder.drain()
+def drain(timeout: float | None = None) -> list[dict]:
+    return _recorder.drain(timeout=timeout)
 
 
 def emit(name: str, cat: str = "tts", ph: str = "i", wid: int = 0,

@@ -64,10 +64,24 @@ def chrome_trace_object(evts: list[dict], label: str = "tts") -> dict:
     }
 
 
+def _fsync(f) -> None:
+    """Flush + fsync (durability satellite: the tail of a killed run must
+    survive — an OS-buffered write dies with the process)."""
+    f.flush()
+    try:
+        import os
+
+        os.fsync(f.fileno())
+    except OSError:
+        pass  # exotic filesystems; the flush already left the process
+
+
 def write_chrome_trace(evts: list[dict], path: str, label: str = "tts") -> int:
-    """Write the trace file; returns the event count (sans metadata)."""
+    """Write the trace file (fsync'd); returns the event count (sans
+    metadata)."""
     with open(path, "w") as f:
         json.dump(chrome_trace_object(evts, label=label), f)
+        _fsync(f)
     return len(evts)
 
 
@@ -78,6 +92,88 @@ def load_trace(path: str) -> list[dict]:
         obj = json.load(f)
     evts = obj["traceEvents"] if isinstance(obj, dict) else obj
     return [e for e in evts if e.get("ph") != "M"]
+
+
+def _metrics_line_event(rec: dict) -> dict:
+    """A metrics-JSONL record back into counter-event shape, so the report
+    summarizer consumes traces and metrics files interchangeably."""
+    args = {k: v for k, v in rec.items()
+            if k not in ("ts_us", "name", "host", "worker")}
+    return {
+        "name": rec.get("name", ""), "cat": "metrics", "ph": "C",
+        "ts": rec.get("ts_us", 0.0), "pid": rec.get("host", 0),
+        "tid": rec.get("worker", 0), "args": args,
+    }
+
+
+def _salvage_truncated(text: str) -> list[dict]:
+    """Best-effort event recovery from a truncated trace: a killed writer
+    leaves a prefix of the ``{"traceEvents": [...`` object — walk the
+    array with ``raw_decode`` and keep every complete event object."""
+    start = text.find("[")
+    if start < 0:
+        return []
+    dec = json.JSONDecoder()
+    evts: list[dict] = []
+    i = start + 1
+    n = len(text)
+    while i < n:
+        while i < n and text[i] in " \t\r\n,":
+            i += 1
+        if i >= n or text[i] != "{":
+            break
+        try:
+            obj, end = dec.raw_decode(text, i)
+        except ValueError:
+            break
+        if isinstance(obj, dict):
+            evts.append(obj)
+        i = end
+    return evts
+
+
+def load_trace_lenient(path: str) -> tuple[list[dict], str | None]:
+    """Load a trace, a metrics JSONL, or the readable prefix of either —
+    the ``tts report`` robustness contract: report what exists. Returns
+    ``(events, warning)``; raises ``OSError`` only when the file cannot
+    be read at all."""
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        return [], f"{path}: empty file"
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict) and isinstance(obj.get("traceEvents"), list):
+        return ([e for e in obj["traceEvents"] if isinstance(e, dict)
+                 and e.get("ph") != "M"], None)
+    if isinstance(obj, list):
+        return ([e for e in obj if isinstance(e, dict)
+                 and e.get("ph") != "M"], None)
+    # Not one whole JSON document: metrics JSONL, or a truncated trace.
+    lines = text.splitlines()
+    recs = []
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line from a mid-write kill
+        if isinstance(rec, dict):
+            recs.append(rec)
+    if recs:
+        if "ph" in recs[0]:  # a JSONL of raw events
+            return ([e for e in recs if e.get("ph") != "M"],
+                    f"{path}: read as event JSONL ({len(recs)} lines)")
+        return ([_metrics_line_event(r) for r in recs],
+                f"{path}: read as metrics JSONL ({len(recs)} lines)")
+    evts = [e for e in _salvage_truncated(text) if e.get("ph") != "M"]
+    if evts:
+        return evts, f"{path}: truncated trace, salvaged {len(evts)} events"
+    return [], f"{path}: unrecognized/corrupt content, no events recovered"
 
 
 def metrics_lines(evts: list[dict]) -> list[dict]:
@@ -105,4 +201,5 @@ def write_metrics_jsonl(evts: list[dict], path: str) -> int:
     with open(path, "a") as f:
         for rec in lines:
             f.write(json.dumps(rec) + "\n")
+        _fsync(f)
     return len(lines)

@@ -1,0 +1,235 @@
+"""``--obs-serve`` / ``tts watch`` — live telemetry streaming.
+
+A zero-dependency localhost HTTP endpoint over the flight recorder's
+snapshot ring (stdlib ``http.server`` in a daemon thread), plus the
+``tts watch`` client. This is the streaming-progress seed of the
+search-as-a-service direction (ROADMAP item 2, arXiv:2002.07062): the
+same snapshots a resident server would push to its tenants.
+
+Endpoints (``127.0.0.1`` only — this is an operator console, not a
+service surface):
+
+  * ``GET /snapshot``      — the latest snapshot as one JSON object
+    (``{}`` until the first dispatch boundary lands);
+  * ``GET /snapshots?n=K`` — the most recent K ring snapshots (JSON
+    array; whole ring without ``n``);
+  * ``GET /state``         — the flight recorder's post-mortem payload
+    (last dispatch per worker, idle map, run meta) — live;
+  * ``GET /stream``        — Server-Sent Events: one ``data:`` line per
+    new snapshot (~the heartbeat cadence, rate-limited at the source);
+  * ``GET /healthz``       — liveness probe.
+
+Server cost model: snapshots are produced by the engines' existing
+dispatch-boundary heartbeats whether or not anyone listens; serving them
+reads the ring under its lock. Nothing here touches device programs or
+the dispatch path — ``--obs-serve`` on a guarded run stays green.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from . import flightrec
+
+#: SSE poll cadence: the ring refreshes at most every
+#: ``flightrec.SNAPSHOT_PERIOD_US``; polling faster only burns cycles.
+STREAM_POLL_S = 0.2
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tts-obs/1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    def _json(self, payload, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
+        url = urlparse(self.path)
+        try:
+            if url.path == "/snapshot":
+                self._json(flightrec.latest() or {})
+            elif url.path == "/snapshots":
+                q = parse_qs(url.query)
+                n = None
+                if "n" in q:
+                    try:
+                        n = max(1, int(q["n"][0]))
+                    except ValueError:
+                        n = None
+                self._json(flightrec.snapshots(n))
+            elif url.path == "/state":
+                self._json(flightrec.recorder().state())
+            elif url.path == "/healthz":
+                self._json({"ok": True})
+            elif url.path == "/stream":
+                self._stream()
+            else:
+                self._json({"error": "unknown path"}, code=404)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; nothing to clean up
+
+    def _stream(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        self.wfile.write(b": tts live snapshot stream\n\n")
+        self.wfile.flush()
+        last_ts = None
+        while not getattr(self.server, "closing", False):
+            snap = flightrec.latest()
+            if snap is not None and snap.get("ts_us") != last_ts:
+                last_ts = snap.get("ts_us")
+                self.wfile.write(
+                    b"data: " + json.dumps(snap).encode() + b"\n\n"
+                )
+                self.wfile.flush()
+            time.sleep(STREAM_POLL_S)
+
+
+class LiveServer:
+    """The ``--obs-serve`` server handle: ``port`` is the bound port
+    (pass 0 to let the OS pick — tests do), ``close()`` stops serving."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.closing = False
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="tts-obs-serve", daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.closing = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def serve(port: int, host: str = "127.0.0.1") -> LiveServer:
+    """Start the live monitor (daemon thread; returns immediately)."""
+    return LiveServer(port, host)
+
+
+# -- the `tts watch` client --------------------------------------------------
+
+
+def format_snapshot(snap: dict) -> str:
+    """One human status line from a snapshot (the watch display unit)."""
+    if not snap:
+        return "waiting for first snapshot..."
+    best = snap.get("best")
+    size = snap.get("size")
+    parts = [
+        f"[{snap.get('tier', '?')}]",
+        f"{snap.get('nodes_per_sec', 0.0):>12,.0f} nodes/s",
+        f"best={best if best is not None else '-'}",
+        f"pool={size if size is not None else '-'}",
+        f"depth={snap.get('depth', 1)}",
+        f"K={snap.get('K') if snap.get('K') is not None else '-'}",
+    ]
+    if snap.get("workers", 0) > 1:
+        parts.append(
+            f"workers={snap['workers']}"
+            f"(idle {snap.get('idle_workers', 0)})"
+        )
+    if snap.get("steals"):
+        parts.append(f"steals={snap['steals']}")
+    parts.append(f"dispatch#{snap.get('seq', 0)}")
+    return "  ".join(parts)
+
+
+def _fetch_json(url: str, timeout: float = 5.0):
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=timeout) as resp:  # noqa: S310 — localhost
+        return json.loads(resp.read().decode())
+
+
+def watch_main(port: int, host: str = "127.0.0.1", interval: float = 1.0,
+               once: bool = False, as_json: bool = False,
+               max_updates: int | None = None) -> int:
+    """``tts watch`` entry point: stream (SSE) with a polling fallback.
+
+    ``once`` prints the current snapshot and exits; ``max_updates`` bounds
+    a streaming session (tests; unbounded for operators, ^C to stop).
+    Returns 0 on success, 2 when the monitor is unreachable.
+    """
+    base = f"http://{host}:{port}"
+    emit = (lambda s: print(json.dumps(s), flush=True)) if as_json else (
+        lambda s: print(format_snapshot(s), flush=True)
+    )
+    if once:
+        try:
+            snap = _fetch_json(base + "/snapshot")
+        except OSError as e:
+            print(f"Error: no live monitor at {base}: {e}", file=sys.stderr)
+            return 2
+        emit(snap)
+        return 0
+    from urllib.request import urlopen
+
+    seen = 0
+    try:
+        try:
+            with urlopen(base + "/stream", timeout=30.0) as resp:  # noqa: S310
+                for raw in resp:
+                    line = raw.decode(errors="replace").strip()
+                    if not line.startswith("data: "):
+                        continue
+                    try:
+                        snap = json.loads(line[len("data: "):])
+                    except ValueError:
+                        continue
+                    emit(snap)
+                    seen += 1
+                    if max_updates is not None and seen >= max_updates:
+                        return 0
+        except OSError as e:
+            if seen == 0 and not _poll_ok(base):
+                print(f"Error: no live monitor at {base}: {e}",
+                      file=sys.stderr)
+                return 2
+        # Stream dropped (run over or timeout): fall back to polling until
+        # the server goes away entirely.
+        last_ts = None
+        while max_updates is None or seen < max_updates:
+            try:
+                snap = _fetch_json(base + "/snapshot")
+            except OSError:
+                return 0 if seen else 2
+            if snap and snap.get("ts_us") != last_ts:
+                last_ts = snap.get("ts_us")
+                emit(snap)
+                seen += 1
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _poll_ok(base: str) -> bool:
+    try:
+        _fetch_json(base + "/healthz", timeout=2.0)
+        return True
+    except OSError:
+        return False

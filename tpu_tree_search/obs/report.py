@@ -229,18 +229,41 @@ def render(summary: dict) -> str:
     return "\n".join(out)
 
 
-def report_main(trace_path: str, as_json: bool = False) -> int:
-    """The ``tts report`` entry point."""
-    from .export import load_trace
+def report_main(trace_paths, as_json: bool = False) -> int:
+    """The ``tts report`` entry point.
 
-    try:
-        evts = load_trace(trace_path)
-    except (OSError, ValueError, KeyError) as e:
-        import sys
+    Accepts one or many files — traces, metrics JSONL, flight-recorder
+    dumps — merged into a single report (multi-worker sessions write one
+    metrics file per host; the union is the honest whole-run view).
+    Robustness contract: a truncated or empty file is summarized as far
+    as it parses, with a warning on stderr and exit 0 — a post-mortem
+    artifact from a killed run must never be unreadable by its own
+    tooling. Exit 2 only when NO input could be read at all."""
+    import sys
 
-        print(f"Error: cannot read trace {trace_path!r}: {e}",
-              file=sys.stderr)
+    from .export import load_trace_lenient
+
+    if isinstance(trace_paths, str):
+        trace_paths = [trace_paths]
+    evts: list[dict] = []
+    readable = 0
+    for path in trace_paths:
+        try:
+            part, warn = load_trace_lenient(path)
+        except OSError as e:
+            print(f"Error: cannot read {path!r}: {e}", file=sys.stderr)
+            continue
+        readable += 1
+        if warn:
+            print(f"Warning: {warn}", file=sys.stderr)
+        evts.extend(part)
+    if not readable:
         return 2
+    if not evts:
+        print("Warning: no events recovered from "
+              f"{len(trace_paths)} file(s); reporting empty summary",
+              file=sys.stderr)
+    evts.sort(key=lambda e: e.get("ts", 0.0))
     summary = summarize(evts)
     try:
         if as_json:

@@ -416,12 +416,15 @@ class _HostComm:
             cut_id = (
                 f"{self._run_uuid}:{self.rounds}" if want_ckpt else None
             )
+            # Timed SPAN: the allgather wall is the measured control-round
+            # latency — the cost model's "exchange" link (obs/costmodel.py).
+            t_x = ev.now_us()
             rows = coll.allgather_obj(
                 (size, max_pool, best, bool(idle), want_ckpt, cut_id)
             )
             gbest = min(r[2] for r in rows)
             shared.publish(gbest)
-            ev.emit("exchange", wid=ev.COMM_TID, host=me, args={
+            ev.complete("exchange", t_x, wid=ev.COMM_TID, host=me, args={
                 "round": self.rounds, "size": size, "best": int(gbest),
                 "idle": bool(idle), "backoff": backoff,
             })
@@ -478,26 +481,38 @@ class _HostComm:
                 if send_to is not None:
                     payload = self._donate_from(pools)
                     self._inflight = payload
+                    blob = pickle.dumps(payload)
+                    # Donation SPAN over the KV put (bytes + duration: the
+                    # "donate" bandwidth sample of the cost model).
+                    t_d = ev.now_us()
                     coll.kv_set(
-                        f"tts/steal/{self.rounds}/{me}->{send_to}",
-                        pickle.dumps(payload),
+                        f"tts/steal/{self.rounds}/{me}->{send_to}", blob
                     )
                     self._inflight = None
                     if payload is not None:
                         self.blocks_sent += 1
                         self.nodes_sent += batch_length(payload)
-                        ev.emit("donate_send", wid=ev.COMM_TID, host=me,
-                                args={"peer": send_to,
-                                      "nodes": batch_length(payload),
-                                      "round": self.rounds})
+                        ev.complete("donate_send", t_d, wid=ev.COMM_TID,
+                                    host=me,
+                                    args={"peer": send_to,
+                                          "nodes": batch_length(payload),
+                                          "bytes": len(blob),
+                                          "round": self.rounds})
                 if recv_from is not None:
-                    batch = pickle.loads(
-                        coll.kv_get(
-                            f"tts/steal/{self.rounds}/{recv_from}->{me}",
-                            self.KV_TIMEOUT_S,
-                        )
+                    t_d = ev.now_us()
+                    raw = coll.kv_get(
+                        f"tts/steal/{self.rounds}/{recv_from}->{me}",
+                        self.KV_TIMEOUT_S,
                     )
+                    batch = pickle.loads(raw)
                     if batch is not None:
+                        # Span covers the KV wait (donor prep + transfer).
+                        ev.complete("donate_recv", t_d, wid=ev.COMM_TID,
+                                    host=me,
+                                    args={"peer": recv_from,
+                                          "nodes": batch_length(batch),
+                                          "bytes": len(raw),
+                                          "round": self.rounds})
                         # Whole block into one local pool (keeps it >= m so
                         # the receiving worker can pop; intra-host stealing
                         # spreads it from there).
@@ -505,10 +520,6 @@ class _HostComm:
                         rrobin = (rrobin + 1) % len(pools)
                         self.blocks_received += 1
                         self.nodes_received += batch_length(batch)
-                        ev.emit("donate_recv", wid=ev.COMM_TID, host=me,
-                                args={"peer": recv_from,
-                                      "nodes": batch_length(batch),
-                                      "round": self.rounds})
             if do_ckpt:
                 # Same round on every host (rows[0][4]): donations above
                 # completed, workers pause at chunk boundaries, each host

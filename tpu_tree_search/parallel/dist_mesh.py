@@ -41,6 +41,7 @@ from ..engine.resident import _emit_device_explored
 from ..engine.results import Diagnostics, PhaseStats, SearchResult
 from ..obs import counters as obs_counters
 from ..obs import events as ev
+from ..obs import flightrec as fr
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
 from .dist import (
@@ -134,6 +135,7 @@ def _host_loop(
         MESH_TARGET,
         resolve_k,
         resolve_pipeline_depth,
+        resolve_target_band,
     )
     from ..engine.resident import resolve_capacity
 
@@ -143,9 +145,23 @@ def _host_loop(
     # along the shared geometric ladder — hosts already run different
     # cycle counts per exchange round, so differing K across hosts changes
     # nothing the exchange protocol depends on. The mesh target band keeps
-    # K bounded by exchange responsiveness.
+    # K bounded by exchange responsiveness — it IS this tier's exchange
+    # period (exchanges ride dispatch boundaries); with TTS_COSTMODEL it
+    # resolves from the measured dispatch-latency fit, and the idle-host
+    # exchange back-off from the measured exchange-round latency.
     k_auto, k_value = resolve_k(K, default_max=16)
-    ctl = AdaptiveK(k_value, target=MESH_TARGET) if k_auto else None
+    band, band_src = resolve_target_band(
+        "dist_mesh", MESH_TARGET, problem, topology=f"dist_mesh-H{H}xD{D}"
+    )
+    if band_src is not None and exchange_sleep_s == 0.0:
+        from ..obs import costmodel as cm
+
+        prof = cm.load(cm.costmodel_path() or "")
+        hit = cm.lookup(prof or {}, *band_src.split("|")) if prof else None
+        measured_sleep = cm.exchange_sleep_s(hit[1]) if hit else None
+        if measured_sleep is not None:
+            exchange_sleep_s = measured_sleep
+    ctl = AdaptiveK(k_value, target=band) if k_auto else None
     depth = resolve_pipeline_depth()
     program = get_mesh_program(problem, mesh, m, M,
                                ctl.K if ctl else k_value, rounds, T, capacity)
@@ -180,6 +196,7 @@ def _host_loop(
     ctr_total: dict | None = None
     prev_best = best
     sizes = np.zeros(D, dtype=np.int32)
+    n_disp = 0  # completed-dispatch sequence (flight-recorder registry)
     queue = DispatchQueue(depth)
     last_ready = time.monotonic()
 
@@ -193,14 +210,20 @@ def _host_loop(
 
     def consume(out, t_enq) -> tuple[int, int, int]:
         nonlocal tree2, sol2, sizes, best, ctr_total, prev_best, per_worker
+        nonlocal n_disp
         t_wait = ev.now_us()
         ti, si, cy, sizes, best, tree_vec, ctr = program.read_scalars(out)
         tree2 += ti
         sol2 += si
+        n_disp += 1
         per_worker += tree_vec.astype(np.int64)
         diagnostics.kernel_launches += cy
         if ctr is not None:
             ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        fr.heartbeat("dist_mesh", host=me, seq=n_disp, cycles=cy,
+                     size=int(sizes.sum()), best=int(best), tree=tree2,
+                     sol=sol2, depth=depth, K=program.K,
+                     inflight=len(queue))
         if ev.enabled():
             now = ev.now_us()
             ev.emit("dispatch", ph="X", ts=t_enq, host=me,
@@ -275,9 +298,15 @@ def _host_loop(
         ev.complete("checkpoint", t_cut, wid=ev.COMM_TID, host=me,
                     args={"tag": str(tag), "ok": ok})
 
+    fr.arm("dist_mesh")
     ev.emit("pipeline", host=me, args={
         "depth": depth, "K": program.K, "k_auto": k_auto, "tier": "dist_mesh",
     })
+    if band_src is not None:
+        ev.emit("costmodel", host=me, args={
+            "source": band_src, "lo_ms": round(1e3 * band[0], 1),
+            "hi_ms": round(1e3 * band[1], 1), "tier": "dist_mesh",
+        })
 
     while True:
         while not queue.full:
@@ -324,11 +353,15 @@ def _host_loop(
             and time.monotonic() - ckpt_last >= checkpoint_interval_s
         )
         cut_id = f"{run_uuid}:{exch_rounds}" if want_ckpt else None
+        # The exchange is a SPAN (not an instant): its duration is the
+        # measured DCN/KV control-round latency — the "exchange" link
+        # class of the cost model (obs/costmodel.py).
+        t_x = ev.now_us()
         rows = coll.allgather_obj(
             (total, bool(idle), int(best), want_ckpt, cut_id)
         )
         gbest = min(r[2] for r in rows)
-        ev.emit("exchange", wid=ev.COMM_TID, host=me, args={
+        ev.complete("exchange", t_x, wid=ev.COMM_TID, host=me, args={
             "round": exch_rounds, "size": total, "best": int(gbest),
             "idle": bool(idle),
         })
@@ -378,35 +411,44 @@ def _host_loop(
             # Steal-half-from-front policy, capped (the dist tier's bounded
             # donation: a huge frontier never ships unbounded over DCN).
             block = p.pop_front_bulk_half(m, 0.5, cap=D * M)
+            blob = pickle.dumps(block)
+            # Donation SPAN over the KV put alone (bytes + duration — the
+            # "donate" bandwidth sample of the cost model); the frontier
+            # download/re-upload around it is charged to the donor's own
+            # dispatch gap, not the link.
+            t_d = ev.now_us()
             coll.kv_set(
-                f"tts/dmesh/{exch_rounds}/{me}->{send_to}",
-                pickle.dumps(block),
+                f"tts/dmesh/{exch_rounds}/{me}->{send_to}", blob
             )
-            upload(p)
             if block is not None:
                 blocks_sent += 1
                 nodes_sent += batch_length(block)
-                ev.emit("donate_send", wid=ev.COMM_TID, host=me,
-                        args={"peer": send_to,
-                              "nodes": batch_length(block),
-                              "round": exch_rounds})
+                ev.complete("donate_send", t_d, wid=ev.COMM_TID, host=me,
+                            args={"peer": send_to,
+                                  "nodes": batch_length(block),
+                                  "bytes": len(blob),
+                                  "round": exch_rounds})
+            upload(p)
         if recv_from is not None:
-            block = pickle.loads(
-                coll.kv_get(
-                    f"tts/dmesh/{exch_rounds}/{recv_from}->{me}",
-                    timeout_s=120.0,
-                )
+            t_d = ev.now_us()
+            raw = coll.kv_get(
+                f"tts/dmesh/{exch_rounds}/{recv_from}->{me}",
+                timeout_s=120.0,
             )
+            block = pickle.loads(raw)
             if block is not None:
+                # Span covers the KV wait (donor prep + transfer): the
+                # measured cost of receiving a donation block.
+                ev.complete("donate_recv", t_d, wid=ev.COMM_TID, host=me,
+                            args={"peer": recv_from,
+                                  "nodes": batch_length(block),
+                                  "bytes": len(raw),
+                                  "round": exch_rounds})
                 p = download()
                 p.push_back_bulk(block)
                 upload(p)
                 blocks_received += 1
                 nodes_received += batch_length(block)
-                ev.emit("donate_recv", wid=ev.COMM_TID, host=me,
-                        args={"peer": recv_from,
-                              "nodes": batch_length(block),
-                              "round": exch_rounds})
         if idle and recv_from is None and exchange_sleep_s:
             time.sleep(exchange_sleep_s)
 

@@ -34,6 +34,7 @@ import numpy as np
 from ..engine.device import DeviceOffloader, bucket_size, drain, warmup
 from ..engine.results import Diagnostics, PhaseStats, SearchResult
 from ..obs import events as ev
+from ..obs import flightrec as fr
 from ..pool import ParallelSoAPool, SoAPool
 from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
 from ..utils import TaskStates
@@ -207,6 +208,7 @@ class _Worker:
         self.sol = 0
         self.best = INF_BOUND
         self.steals = 0
+        self.chunks = 0  # completed-chunk sequence (flight recorder)
         self.diagnostics = Diagnostics()
         self.error: BaseException | None = None
 
@@ -267,9 +269,13 @@ def _worker_loop(
                 ev.emit("incumbent", wid=w.wid, host=host_id,
                         args={"best": w.best})
             w.pool.locked_push_back_bulk(res.children)
+            w.chunks += 1
             ev.complete("chunk", t_chunk, wid=w.wid, host=host_id,
                         args={"count": count, "tree": res.tree_inc,
                               "sol": res.sol_inc})
+            fr.heartbeat("multi", host=host_id, wid=w.wid, seq=w.chunks,
+                         best=w.best, tree=w.tree, sol=w.sol,
+                         steals=w.steals)
 
         while True:
             if gate is not None:
@@ -288,6 +294,7 @@ def _worker_loop(
                 if idle_t0 is not None:
                     ev.complete("idle", idle_t0, wid=w.wid, host=host_id)
                     idle_t0 = None
+                    fr.set_idle(host_id, w.wid, False)
                 t_chunk = ev.now_us()
                 if shared is not None:
                     w.best = min(w.best, shared.read())
@@ -340,6 +347,7 @@ def _worker_loop(
                 # few microseconds and would flood the trace.
                 ev.emit("steal_miss", wid=w.wid, host=host_id)
                 idle_t0 = ev.now_us()
+                fr.set_idle(host_id, w.wid, True)
             if stop_event is not None:
                 # Dist mode: local all-idle is NOT the end — the host may
                 # still receive stolen work from another host. Poll until
@@ -396,6 +404,7 @@ def run_workers(
     thread alongside the workers. It owns global termination: workers then
     poll until ``stop_event`` is set instead of exiting on local all-idle.
     """
+    fr.arm("multi")
     pools = _partition(problem, pool, D)
     leftover = SoAPool(problem.node_fields())
     states = TaskStates(D)

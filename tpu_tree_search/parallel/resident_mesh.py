@@ -45,6 +45,7 @@ from ..engine.resident import _emit_device_explored, _make_program
 from ..engine.results import Diagnostics, PhaseStats, SearchResult
 from ..obs import counters as obs_counters
 from ..obs import events as ev
+from ..obs import flightrec as fr
 from ..ops import pallas_kernels as PK
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, index_batch
@@ -540,13 +541,20 @@ def mesh_resident_search(
         MESH_TARGET,
         resolve_k,
         resolve_pipeline_depth,
+        resolve_target_band,
     )
 
     k_auto, k_value = resolve_k(K, default_max=16)
     # The mesh tier's K is bounded by balancing responsiveness: incumbent
     # pmin folds and diffusion rounds happen per dispatch, so the ladder
-    # targets a tighter host period than the single-device tier.
-    ctl = AdaptiveK(k_value, target=MESH_TARGET) if k_auto else None
+    # targets a tighter host period than the single-device tier — and that
+    # band IS the tier's steal (diffusion) period. With TTS_COSTMODEL it
+    # resolves from the measured dispatch-latency fit instead of the
+    # fixed default (engine/pipeline.py resolve_target_band).
+    band, band_src = resolve_target_band(
+        "mesh", MESH_TARGET, problem, topology=f"mesh-D{D}"
+    )
+    ctl = AdaptiveK(k_value, target=band) if k_auto else None
     depth = resolve_pipeline_depth()
     program = get_mesh_program(problem, mesh, m, M,
                                ctl.K if ctl else k_value, rounds, T, capacity)
@@ -583,6 +591,7 @@ def mesh_resident_search(
     ctr_total: dict | None = None
     fb_tree = fb_sol = 0  # saturation-fallback host increments (obs parity)
     prev_best = best
+    n_disp = 0  # completed-dispatch sequence (flight-recorder registry)
     queue = DispatchQueue(depth)
 
     def obs_result() -> dict | None:
@@ -600,14 +609,19 @@ def mesh_resident_search(
 
     def consume(out, t_enq) -> tuple[int, int, int]:
         nonlocal tree2, sol2, sizes, best, ctr_total, prev_best, per_worker
+        nonlocal n_disp
         t_wait = ev.now_us()
         ti, si, cy, sizes, best, tree_vec, ctr = program.read_scalars(out)
         tree2 += ti
         sol2 += si
+        n_disp += 1
         per_worker += tree_vec.astype(np.int64)
         diagnostics.kernel_launches += cy
         if ctr is not None:
             ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        fr.heartbeat("mesh", seq=n_disp, cycles=cy, size=int(sizes.sum()),
+                     best=best, tree=tree2, sol=sol2, depth=depth,
+                     K=program.K, inflight=len(queue))
         if ev.enabled():
             now = ev.now_us()
             ev.emit("dispatch", ph="X", ts=t_enq,
@@ -643,9 +657,15 @@ def mesh_resident_search(
         snapshot_fn, drain_fn=drain_queue,
     )
 
+    fr.arm("mesh")
     ev.emit("pipeline", args={
         "depth": depth, "K": program.K, "k_auto": k_auto, "tier": "mesh",
     })
+    if band_src is not None:
+        ev.emit("costmodel", args={
+            "source": band_src, "lo_ms": round(1e3 * band[0], 1),
+            "hi_ms": round(1e3 * band[1], 1), "tier": "mesh",
+        })
     last_ready = time.monotonic()
 
     while True:
