@@ -659,15 +659,68 @@ def eval_microbench(problem, on_tpu: bool, iters: int | None = None) -> dict:
 COMPACT_MODES = ("scatter", "sort", "search", "dense")
 
 
+def _phaseprof_armed() -> bool:
+    """Session-level TTS_PHASEPROF=1: hardware sessions arm it for the
+    decomposition stages; the default bench never pays the armed
+    variant's compiles or callback clocks."""
+    from tpu_tree_search.obs import phases as obs_phases
+
+    return obs_phases.phase_profiling_enabled()
+
+
+def phase_split_probe(problem, m: int, M: int, K: int = 64,
+                      max_steps: int = 2) -> dict | None:
+    """Measured per-phase cycle split from a short ARMED resident run
+    (TTS_PHASEPROF=1, obs/phases.py): the real engine with its phase
+    clocks on, bounded to ``max_steps`` dispatches.  The armed program is
+    a separate cache-keyed variant, so the headline program is untouched.
+    Returns ``{"ns", "shares", "cycles", "dominant"}`` or None (the probe
+    is best-effort and must never cost the bench line)."""
+    try:
+        from tpu_tree_search.engine.resident import resident_search
+        from tpu_tree_search.obs import phases as obs_phases
+
+        with _env_override("TTS_PHASEPROF", "1"):
+            res = resident_search(problem, m=m, M=M, K=K,
+                                  max_steps=max_steps)
+        pp = res.phase_profile
+        if not pp or not pp.get("total"):
+            return None
+        dom = obs_phases.dominant_phase(pp)
+        return {
+            "ns": {k: int(v) for k, v in pp.items()},
+            "shares": {k: round(v, 4)
+                       for k, v in obs_phases.shares(pp).items()},
+            "cycles": int(res.diagnostics.kernel_launches),
+            "dominant": dom[0] if dom else None,
+        }
+    except Exception:  # noqa: BLE001 — calibration is best-effort
+        return None
+
+
 def eval_cycle_ms(problem, m: int, M: int, cycles: int = 64) -> float | None:
     """Measured evaluator-in-loop cost per cycle at the production chunk
-    shape: a stripped while_loop whose body runs ONLY the evaluator — no
-    pop, no compaction, no push (scripts/cycle_profile.py's c-loop, inlined
-    so pick_compact can price the survivor path per mode).  A mode's
-    maintenance share is then its measured cycle_ms minus this; the
-    on-device ``push_rows`` counter carries the matching WORK series
-    (docs/OBSERVABILITY.md).  Returns None on any failure — the
-    decomposition is best-effort and must never cost the bench line."""
+    shape.
+
+    When the phase profiler is armed for the session (``TTS_PHASEPROF=1``
+    — hardware sessions arm it for the decomposition stages), the number
+    comes from the profiler itself: the ``eval`` phase clock of a short
+    armed resident run (``phase_split_probe``) — ONE decomposition
+    mechanism, measured inside the real loop.  Otherwise (the CPU/default
+    fallback) it is the original stripped while_loop whose body runs ONLY
+    the evaluator — no pop, no compaction, no push
+    (scripts/cycle_profile.py's c-loop, inlined so pick_compact can price
+    the survivor path per mode).  A mode's maintenance share is then its
+    measured cycle_ms minus this; the on-device ``push_rows`` counter
+    carries the matching WORK series (docs/OBSERVABILITY.md).  Returns
+    None on any failure — the decomposition is best-effort and must never
+    cost the bench line."""
+    from tpu_tree_search.obs import phases as obs_phases
+
+    if obs_phases.phase_profiling_enabled():
+        split = phase_split_probe(problem, m, M, K=cycles)
+        if split and split["cycles"]:
+            return round(split["ns"]["eval"] / 1e6 / split["cycles"], 3)
     try:
         import jax
         import jax.numpy as jnp
@@ -748,7 +801,8 @@ def _mode_timeout(seconds: float | None):
 
 
 def pick_compact(run_fn, parity_fn, budget_s: float | None = None,
-                 eval_ms: float | None = None, auto_mode: str | None = None):
+                 eval_ms: float | None = None, auto_mode: str | None = None,
+                 phase_probe=None):
     """Measure ``run_fn()`` under each compaction mode (TTS_COMPACT) and
     pick the fastest PARITY-PASSING one (fallback: fastest overall — a
     fast-but-wrong mode must never displace a clean measurement, but if
@@ -761,6 +815,12 @@ def pick_compact(run_fn, parity_fn, budget_s: float | None = None,
     implied maintenance (pop+compact+push) ms/cycle; ``auto_mode`` records
     what ``TTS_COMPACT=auto`` would have resolved for this config, so the
     artifact shows whether the policy table agrees with the measurement.
+
+    ``phase_probe`` (armed sessions: a zero-arg callable wrapping
+    ``phase_split_probe``) runs once per surviving mode UNDER that mode's
+    ``TTS_COMPACT`` pin, so the row records the measured per-phase cycle
+    split of each compaction mode — the phase-profiler counterpart of the
+    ``eval_ms`` subtraction (one decomposition mechanism when armed).
 
     ``budget_s`` is a HARD bound on the whole A/B, not just a start gate:
     each mode runs inside its remaining slice of the budget under
@@ -775,6 +835,7 @@ def pick_compact(run_fn, parity_fn, budget_s: float | None = None,
     to run. Shared by the headline A/B and the N-Queens probe so the mode
     list and selection rule cannot drift apart."""
     runs, nps, par, errors = {}, {}, {}, {}
+    phase_splits: dict = {}
     t0 = time.monotonic()
     skipped = []
     for i, mode in enumerate(COMPACT_MODES):
@@ -793,6 +854,10 @@ def pick_compact(run_fn, parity_fn, budget_s: float | None = None,
             with _env_override("TTS_COMPACT", mode), \
                     _mode_timeout(budget_s if i == 0 else remaining):
                 r = run_fn()
+                if phase_probe is not None:
+                    # Short armed run under the same mode pin: the row's
+                    # measured phase split (still inside the timeout).
+                    phase_splits[mode] = phase_probe()
         except TimeoutError as e:
             errors[mode] = f"TimeoutError: {e}"
             continue
@@ -813,6 +878,8 @@ def pick_compact(run_fn, parity_fn, budget_s: float | None = None,
             if eval_ms is not None:
                 row["eval_ms"] = eval_ms
                 row["maint_ms"] = round(row["cycle_ms"] - eval_ms, 3)
+            if phase_splits.get(mode):
+                row["phases"] = phase_splits[mode]
             decomp[mode] = row
     if not runs:
         # Preserve the per-mode diagnostics even when every mode failed —
@@ -1205,6 +1272,10 @@ def _main(partial: BenchPartial) -> int:
                 auto_mode=resolve_compact_mode(
                     prob_hl, HEADLINE_M, prob_hl.jobs, jax.devices()[0]
                 ),
+                phase_probe=(
+                    (lambda: phase_split_probe(prob_hl, 25, HEADLINE_M))
+                    if _phaseprof_armed() else None
+                ),
             )
         if best_run is not None:
             res, nps, elapsed, device_phase = best_run
@@ -1417,6 +1488,10 @@ def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
                 budget_s=300.0,
                 eval_ms=eval_cycle_ms(_p2, lb2_m, lb2_M),
                 auto_mode=resolve_compact_mode(_p2, lb2_M, _p2.jobs),
+                phase_probe=(
+                    (lambda: phase_split_probe(_p2, lb2_m, lb2_M))
+                    if _phaseprof_armed() else None
+                ),
             )
         if lb2_best is not None:
             res2, nps2, _, _ = lb2_best
@@ -1483,6 +1558,10 @@ def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
                 budget_s=420.0,
                 eval_ms=eval_cycle_ms(_pq, 25, 65536, cycles=16),
                 auto_mode=resolve_compact_mode(_pq, 65536, _pq.N),
+                phase_probe=(
+                    (lambda: phase_split_probe(_pq, 25, 65536, K=16))
+                    if _phaseprof_armed() else None
+                ),
             )
             if nq_compact is not None:
                 # The stats were measured on the PROBE config, not N=15 —

@@ -78,6 +78,34 @@ done
 [ -f COSTMODEL.json ] && echo "COSTMODEL.json present (arm future runs with TTS_COSTMODEL=COSTMODEL.json)"
 [ -f BENCH_PARTIAL.json ] && echo "BENCH_PARTIAL.json present (per-stage bench provenance)"
 
+echo "== 7b/9 phase decomposition + XLA trace (tts profile) =="
+# The measured cycle decomposition (ROADMAP item 1's fallback deliverable
+# and item 3's gate): armed phase clocks on the two headline configs, plus
+# ONE steady-state XLA op-level capture. The armed program is a separate
+# cache-keyed variant — these runs are decomposition artifacts, never
+# headline numbers (docs/OBSERVABILITY.md leg 7; these artifacts are
+# committed only from real TPU sessions — CPU smoke routes to tempdir).
+if timeout 900 python -m tpu_tree_search.cli profile pfsp --inst 14 \
+    --tier device --xla-trace /tmp/tts_xla_trace \
+    --trace /tmp/tts_phase_ta014.json --json \
+    | tee PHASES_ta014_lb1.json; then
+  timeout 120 python -m tpu_tree_search.cli report /tmp/tts_phase_ta014.json \
+    || echo "PHASE REPORT FAILED"
+  # Bank the XProf capture directory listing (the .pb/.json.gz payloads
+  # stay in /tmp; the listing proves the capture landed).
+  find /tmp/tts_xla_trace -type f | tee XLA_TRACE_MANIFEST.txt
+else
+  echo "TTS PROFILE (ta014 lb1) FAILED"
+fi
+timeout 900 python -m tpu_tree_search.cli profile nqueens --N 15 \
+    --tier device --json | tee PHASES_nqueens_n15.json \
+  || echo "TTS PROFILE (N-Queens N=15) FAILED"
+# Armed bench decomposition: pick_compact records the per-mode phase
+# split and eval_cycle_ms comes from the profiler (one mechanism).
+TTS_PHASEPROF=1 TTS_BENCH_EXPRESS=1 timeout 900 python bench.py \
+    > /tmp/tts_bench_phase.json \
+  || echo "ARMED EXPRESS BENCH FAILED (decomposition rows missing)"
+
 echo "== 8/9 chunk-size sweeps (un-measured configs first) =="
 # N-Queens chunk sweep (first ever, VERDICT r5 #2): the default knob is
 # TTS_COMPACT=auto now (dense shift path for N-Queens); the scatter pin is

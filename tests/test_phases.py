@@ -1,0 +1,308 @@
+"""Per-phase cycle-clock profiler (`tpu_tree_search/obs/phases.py`,
+docs/OBSERVABILITY.md leg 7): byte-identical jaxprs when off, the exact
+phase-sum == total telescoping identity, bit-identical search results
+armed vs not, cross-tier harvest parity, guard interaction, and the
+`tts report` / `tts profile` decomposition table."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_tree_search import cli
+from tpu_tree_search.obs import phases, report
+from tpu_tree_search.problems import NQueensProblem
+
+
+def _cycle_sum(pp: dict) -> int:
+    return sum(pp[s] for s in phases.CYCLE_SLOTS)
+
+
+# -- zero-cost disabled path ----------------------------------------------
+
+
+def _resident_step_jaxpr(monkeypatch, phaseprof: str | None,
+                         obs: str | None = None) -> tuple[str, int]:
+    import jax
+
+    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
+
+    for knob, val in (("TTS_PHASEPROF", phaseprof), ("TTS_OBS", obs)):
+        if val is None:
+            monkeypatch.delenv(knob, raising=False)
+        else:
+            monkeypatch.setenv(knob, val)
+    prob = NQueensProblem(N=8)  # fresh instance: no cached programs
+    capacity, M = resolve_capacity(prob, 64, None)
+    prog = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
+    state = prog.init_state({}, 0)
+    jaxpr = jax.make_jaxpr(prog._step)(*state)
+    return str(jaxpr), len(jaxpr.jaxpr.outvars)
+
+
+def test_disabled_jaxpr_identical_and_clock_free(monkeypatch):
+    off1, n_off1 = _resident_step_jaxpr(monkeypatch, None)
+    off2, n_off2 = _resident_step_jaxpr(monkeypatch, "0")
+    on, n_on = _resident_step_jaxpr(monkeypatch, "1")
+    both, n_both = _resident_step_jaxpr(monkeypatch, "1", obs="1")
+    # Off builds are byte-identical: the phase block is compiled out, not
+    # branched — exactly the counter-block contract (tests/test_obs.py).
+    assert off1 == off2
+    assert n_off1 == n_off2 == 7
+    # Armed build carries exactly one extra output leaf (the phase block);
+    # with device counters too, one more (order: ..., ctr, ph).
+    assert n_on == 8
+    assert n_both == 9
+    assert on != off1
+
+
+def test_program_cache_keys_on_phaseprof(monkeypatch):
+    import jax
+
+    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
+
+    prob = NQueensProblem(N=8)
+    capacity, M = resolve_capacity(prob, 64, None)
+    monkeypatch.delenv("TTS_PHASEPROF", raising=False)
+    p_off = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
+    monkeypatch.setenv("TTS_PHASEPROF", "1")
+    p_on = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
+    assert p_off is not p_on and p_on.phaseprof and not p_off.phaseprof
+    monkeypatch.delenv("TTS_PHASEPROF", raising=False)
+    assert _make_program(prob, 5, M, 4, capacity, jax.devices()[0]) is p_off
+
+
+# -- armed semantics: bit-identity + the telescoping identity --------------
+
+
+def test_resident_bit_identity_and_phase_sum(monkeypatch):
+    from tpu_tree_search.engine.resident import resident_search
+
+    monkeypatch.delenv("TTS_PHASEPROF", raising=False)
+    res_off = resident_search(NQueensProblem(N=9), m=5, M=128)
+    monkeypatch.setenv("TTS_PHASEPROF", "1")
+    res_on = resident_search(NQueensProblem(N=9), m=5, M=128)
+    # Clock reads feed only the phase block: search results stay
+    # bit-identical armed vs not.
+    assert (res_on.explored_tree, res_on.explored_sol, res_on.best) == \
+        (res_off.explored_tree, res_off.explored_sol, res_off.best)
+    assert res_off.phase_profile is None
+    pp = res_on.phase_profile
+    assert pp is not None and pp["total"] > 0
+    # The stated consistency bound: within a cycle the same clock readings
+    # bound adjacent phases, so the in-cycle slots telescope to `total`
+    # EXACTLY (uint32 wrap arithmetic is exact; host merge uses int64+).
+    assert _cycle_sum(pp) == pp["total"]
+    # Sanity: measured on-device cycle time fits inside the run's wall
+    # clock (single device — no aggregation slack needed).
+    assert pp["total"] < res_on.elapsed * 1e9
+    # The armed result also rides the obs payload for stats lines.
+    assert res_on.obs["device_phases"] == pp
+
+
+def test_mesh_phase_parity(monkeypatch):
+    import jax
+
+    from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    monkeypatch.setenv("TTS_PHASEPROF", "1")
+    res = mesh_resident_search(NQueensProblem(N=8), m=5, M=64, D=4)
+    # Counting invariance is untouched by the clocks.
+    assert (res.explored_tree, res.explored_sol) == (2056, 92)
+    pp = res.phase_profile
+    assert pp is not None
+    # Telescoping holds summed across shards too (it holds per shard and
+    # the merge is a plain sum).
+    assert _cycle_sum(pp) == pp["total"] > 0
+    # The mesh tiers charge the pmin fold + ppermute diffusion to
+    # `balance` — present (>= 0; N=8 on 4 shards always runs rounds).
+    assert pp["balance"] >= 0 and pp["loop"] > 0
+
+
+def test_dist_mesh_phase_parity(monkeypatch):
+    import jax
+
+    from tpu_tree_search.parallel.dist_mesh import dist_mesh_search
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    monkeypatch.setenv("TTS_PHASEPROF", "1")
+    res = dist_mesh_search(NQueensProblem(N=8), m=5, M=64, D=2, num_hosts=2)
+    assert (res.explored_tree, res.explored_sol) == (2056, 92)
+    pp = res.phase_profile
+    assert pp is not None
+    assert _cycle_sum(pp) == pp["total"] > 0
+
+
+def test_guard_green_while_armed(monkeypatch):
+    from tpu_tree_search.engine.resident import resident_search
+
+    monkeypatch.setenv("TTS_PHASEPROF", "1")
+    monkeypatch.setenv("TTS_GUARD", "1")
+    # The armed variant harvests at the same dispatch boundaries: zero new
+    # transfers, zero steady-state recompiles — GuardViolation would raise.
+    res = resident_search(NQueensProblem(N=9), m=5, M=128)
+    assert (res.explored_tree, res.explored_sol) == (8393, 352)
+
+
+# -- merge/share helpers ---------------------------------------------------
+
+
+def test_merge_host_and_shares():
+    import numpy as np
+
+    blk = np.zeros((2, phases.NSLOTS + 1), np.uint32)
+    blk[0, phases.IDX["eval"]] = 100
+    blk[0, phases.IDX["total"]] = 150
+    blk[1, phases.IDX["eval"]] = 50
+    blk[1, phases.IDX["total"]] = 150
+    blk[:, phases.TPREV] = 12345  # carried clock reading: never merged
+    tot = phases.merge_host(None, blk)
+    assert tot["eval"] == 150 and tot["total"] == 300
+    assert "tprev" not in tot and len(tot) == phases.NSLOTS
+    tot = phases.merge_host(tot, blk[:1])
+    assert tot["eval"] == 250
+    sh = phases.shares(tot)
+    assert sh["eval"] == pytest.approx(250 / 450)
+    name, share = phases.dominant_phase(tot)
+    assert name == "eval"
+    assert phases.dominant_phase({}) is None
+    assert phases.dominant_phase(None) is None
+
+
+# -- report/CLI surfaces ---------------------------------------------------
+
+
+def _phase_counter_event(ns: dict) -> dict:
+    return {"name": "device_phases", "cat": "metrics", "ph": "C",
+            "ts": 1.0, "pid": 0, "tid": 0, "args": ns}
+
+
+def test_report_phase_table_golden(capsys):
+    evts = [
+        _phase_counter_event({"pop": 100, "eval": 200, "compact": 410,
+                              "push": 250, "overflow": 40, "balance": 5,
+                              "loop": 30, "total": 1000}),
+        _phase_counter_event({"pop": 0, "eval": 0, "compact": 0,
+                              "push": 0, "overflow": 0, "balance": 0,
+                              "loop": 0, "total": 0}),
+    ]
+    summary = report.summarize(evts)
+    pd = summary["phase_decomp"]
+    assert pd["ns"]["compact"] == 410 and pd["ns"]["total"] == 1000
+    assert pd["dominant"] == "compact"
+    assert pd["dominant_share"] == pytest.approx(0.41)
+    text = report.render(summary)
+    # Golden lines of the decomposition table.
+    assert "phase decomposition (on-device cycle clocks, ns):" in text
+    assert "next structural cost: compaction, 41% of cycle" in text
+    assert "bound evaluation" in text and "fused prune+push" in text
+    # No device_phases events -> no table, no crash.
+    empty = report.summarize([])
+    assert empty["phase_decomp"] is None
+    assert "next structural cost" not in report.render(empty)
+
+
+def test_cli_profile_subcommand(monkeypatch, capsys):
+    monkeypatch.delenv("TTS_PHASEPROF", raising=False)
+    rc = cli.main(["profile", "nqueens", "--N", "8", "--tier", "device",
+                   "--M", "64", "--m", "5", "--json"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "phase decomposition (on-device cycle clocks, ns):" in out
+    assert "next structural cost:" in out
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["obs"]["device_phases"]["total"] > 0
+    # The pin is restored: a later build in this process is unarmed.
+    import os
+
+    assert os.environ.get("TTS_PHASEPROF") is None
+
+
+def test_cli_profile_requires_run_command(capsys):
+    with pytest.raises(SystemExit):
+        cli.main(["profile"])
+    with pytest.raises(SystemExit):
+        cli.main(["profile", "report", "x.json"])
+
+
+def test_cli_phase_profile_flag_rejected_off_resident():
+    with pytest.raises(SystemExit):
+        cli.main(["nqueens", "--tier", "seq", "--phase-profile"])
+    with pytest.raises(SystemExit):
+        cli.main(["nqueens", "--tier", "device", "--engine", "offload",
+                  "--phase-profile"])
+
+
+def test_xla_trace_window_brackets_steady_state(tmp_path, monkeypatch):
+    calls = []
+
+    class _FakeProfiler:
+        @staticmethod
+        def start_trace(d):
+            calls.append(("start", d))
+
+        @staticmethod
+        def stop_trace():
+            calls.append(("stop", None))
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler)
+    monkeypatch.setenv("TTS_XLA_TRACE", str(tmp_path / "xt"))
+    win = phases.XlaTraceWindow("resident")
+    win.on_dispatch(1)  # first dispatch = compile; window stays closed
+    assert calls == []
+    win.on_dispatch(2)
+    assert calls == [("start", str(tmp_path / "xt"))]
+    # A second window (dist_mesh virtual-host thread) is a no-op while
+    # the first is active — the jax profiler is process-global.
+    win2 = phases.XlaTraceWindow("dist_mesh")
+    win2.on_dispatch(5)
+    win2.close()
+    assert calls == [("start", str(tmp_path / "xt"))]
+    win.on_dispatch(3)  # already started: no re-arm
+    win.close()
+    assert calls[-1] == ("stop", None)
+    # Released: a later run can open a new window.
+    win3 = phases.XlaTraceWindow("resident")
+    assert win3._owner
+    win3.close()
+
+
+def test_cli_xla_trace_end_to_end(tmp_path, monkeypatch):
+    import os
+
+    out_dir = tmp_path / "xprof"
+    rc = cli.main(["nqueens", "--N", "9", "--tier", "device", "--M", "128",
+                   "--m", "5", "--K", "4", "--xla-trace", str(out_dir)])
+    assert rc == 0
+    # The steady-state capture landed (jax writes
+    # plugins/profile/<ts>/*.xplane.pb under the directory).
+    files = [f for _, _, fs in os.walk(out_dir) for f in fs]
+    assert files, "no XLA trace artifacts written"
+    assert os.environ.get("TTS_XLA_TRACE") is None
+
+
+def test_flightrec_snapshot_names_dominant_phase(monkeypatch):
+    from tpu_tree_search.obs import flightrec
+    from tpu_tree_search.obs.live import format_snapshot
+
+    monkeypatch.setenv("TTS_FLIGHTREC", "1")
+    rec = flightrec.FlightRecorder(snapshot_period_us=0.0)
+    rec.heartbeat("resident", seq=1, cycles=4, size=10, best=3, tree=100,
+                  sol=1, phases={"pop": 10, "eval": 20, "compact": 50,
+                                 "push": 15, "overflow": 5, "balance": 0,
+                                 "loop": 2, "total": 100})
+    snap = rec.latest()
+    assert snap["dominant_phase"] == "compact"
+    assert snap["dominant_phase_share"] == pytest.approx(0.5)
+    assert snap["phases"]["compact"] == 50
+    # /state (the post-mortem payload) carries the split per worker.
+    st = rec.state()
+    assert st["last_dispatch"]["h0/w0"]["phases"]["compact"] == 50
+    # The watch line names it.
+    assert "phase=compact:50%" in format_snapshot(snap)

@@ -154,6 +154,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "TTS_COSTMODEL=PATH makes later runs resolve "
                         "their K bands from it; implies TTS_OBS=1 unless "
                         "TTS_OBS is already set")
+    common.add_argument("--phase-profile", action="store_true",
+                        help="resident tiers: arm the on-device per-phase "
+                        "cycle clocks (pop/eval/compact/push/overflow + "
+                        "mesh balance — obs/phases.py). Builds a separate "
+                        "cache-keyed program variant (equivalent to "
+                        "TTS_PHASEPROF=1); search results stay "
+                        "bit-identical, the decomposition table prints "
+                        "with the results. Never use for headline "
+                        "measurements — see `tts profile` and "
+                        "docs/OBSERVABILITY.md leg 7")
+    common.add_argument("--xla-trace", type=str, default=None,
+                        metavar="DIR",
+                        help="capture an XLA profiler trace of the "
+                        "steady-state dispatch window into DIR (opens "
+                        "after the first dispatch — warmup and while-loop "
+                        "compile excluded; view with TensorBoard/XProf). "
+                        "Equivalent to TTS_XLA_TRACE=DIR; --profile "
+                        "traces the whole session instead")
     common.add_argument("--guard", action="store_true",
                         help="resident tiers: assert every steady-state "
                         "device dispatch performs zero recompilations and "
@@ -205,6 +223,17 @@ def build_parser() -> argparse.ArgumentParser:
     rep.add_argument("--json", action="store_true", dest="report_json",
                      help="emit the summary as one JSON object")
 
+    prof = sub.add_parser(
+        "profile",
+        help="run a search with the per-phase cycle clocks armed and "
+        "print the decomposition table (plus an optional --xla-trace "
+        "capture): `tts profile pfsp --inst 14 --tier device "
+        "[--xla-trace DIR]` — sugar for the same run command with "
+        "--phase-profile forced on (docs/OBSERVABILITY.md leg 7)",
+    )
+    prof.add_argument("rest", nargs=argparse.REMAINDER,
+                      help="a full run command (problem + flags)")
+
     watch = sub.add_parser(
         "watch",
         help="live view of a run started with --obs-serve PORT: one "
@@ -247,6 +276,18 @@ def validate_args(parser: argparse.ArgumentParser, args) -> None:
             "--engine offload is not available for this tier "
             "(mesh/dist_mesh are resident-only; use --tier multi for "
             "host-orchestrated offload across devices)"
+        )
+    if args.phase_profile and not uses_compaction(args):
+        parser.error(
+            "--phase-profile arms the resident loops' on-device phase "
+            "clocks (--tier device with the resident engine, mesh, "
+            "dist_mesh); the offload/multi/dist workers have no device "
+            "cycle to decompose"
+        )
+    if args.xla_trace is not None and args.profile is not None:
+        parser.error(
+            "--xla-trace (steady-state dispatch window) and --profile "
+            "(whole session) are both XLA profiler captures — pick one"
         )
     if args.compact is not None and not uses_compaction(args):
         parser.error(
@@ -386,6 +427,10 @@ def run_tier(problem, args):
         pins["TTS_LB2_PAIRBLOCK"] = args.lb2_pairblock
     if args.guard:
         pins["TTS_GUARD"] = "1"
+    if args.phase_profile:
+        pins["TTS_PHASEPROF"] = "1"
+    if args.xla_trace is not None:
+        pins["TTS_XLA_TRACE"] = args.xla_trace
     if (
         (args.trace is not None or args.metrics_file is not None
          or args.obs_serve is not None or args.costmodel is not None)
@@ -532,6 +577,12 @@ def print_settings(args) -> None:
         )
         print(f"Dispatch pipeline (TTS_PIPELINE): {pknob}; "
               f"K schedule (TTS_K): {kknob}")
+        if args.phase_profile:
+            print("Phase profiler (TTS_PHASEPROF): armed — separate "
+                  "program variant, NOT a headline measurement")
+        if args.xla_trace is not None:
+            print(f"XLA trace capture (TTS_XLA_TRACE): {args.xla_trace} "
+                  "(steady-state dispatch window)")
     print("=================================================")
 
 
@@ -570,6 +621,14 @@ def print_results(args, problem, res) -> None:
         tag = " (auto)" if res.k_auto else ""
         print(f"Dispatch pipeline: depth={res.pipeline_depth}, "
               f"K={res.k_resolved}{tag}")
+    if res.phase_profile:
+        # The `tts profile` deliverable: the measured on-device cycle
+        # decomposition, closed by the dominant-phase call-out.
+        from .obs import phases as obs_phases
+        from .obs.report import phase_table
+
+        for line in phase_table(obs_phases.decomp(res.phase_profile)):
+            print(line)
     d = res.diagnostics
     if d.kernel_launches:
         dbuf = (
@@ -756,6 +815,22 @@ def enable_compile_cache() -> None:
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.problem == "profile":
+        # `tts profile <run-args>`: the same run command with the phase
+        # clocks forced on; the decomposition table prints with the
+        # results (add --xla-trace DIR inside <run-args> to also bank a
+        # steady-state XLA capture).
+        rest = [a for a in args.rest if a != "--"]
+        if not rest:
+            parser.error(
+                "profile: pass a full run command, e.g. "
+                "`tts profile pfsp --inst 14 --tier device`"
+            )
+        args = parser.parse_args(rest)
+        if args.problem in ("lint", "report", "watch", "profile"):
+            parser.error("profile wraps a search run, not another "
+                         "subcommand")
+        args.phase_profile = True
     if args.problem == "lint":
         # Pure static analysis: no jax import, no backend init.
         from .analysis import run_lint_cli

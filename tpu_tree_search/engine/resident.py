@@ -48,6 +48,7 @@ import numpy as np
 from ..obs import counters as obs_counters
 from ..obs import events as ev
 from ..obs import flightrec as fr
+from ..obs import phases as obs_phases
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem
 from ..problems.nqueens import NQueensProblem
@@ -149,6 +150,10 @@ class _ResidentProgram:
         # a counter-free build (compiled out, not branched). _make_program
         # keys its cache on this flag.
         self.obs = obs_counters.device_counters_enabled()
+        # Per-phase cycle clocks (TTS_PHASEPROF=1, obs/phases.py): a
+        # separate cache-keyed program variant for `tts profile` — when
+        # off, nothing below is traced and the jaxpr is byte-identical.
+        self.phaseprof = obs_phases.phase_profiling_enabled()
         self._step = self._build()
 
     def loop_fns(self, K: int | None = None):
@@ -157,8 +162,11 @@ class _ResidentProgram:
         the single-device step and, per shard, by the mesh-resident tier.
         With ``self.obs`` the carry gains one trailing ``(NSLOTS,)`` int32
         counter block (obs/counters.py), accumulated per cycle and harvested
-        at the dispatch boundary; when off the carry is exactly the 7-tuple
-        above."""
+        at the dispatch boundary; with ``self.phaseprof`` a final
+        ``(phases.NSLOTS + 1,)`` uint32 phase-clock block rides behind it
+        (obs/phases.py — clock reads fenced by ``lax.optimization_barrier``
+        at the pop/eval/compact/push boundaries); when both are off the
+        carry is exactly the 7-tuple above."""
         import jax.numpy as jnp
         from jax import lax
 
@@ -169,6 +177,7 @@ class _ResidentProgram:
         K = self.K if K is None else K
         Mn = M * n
         obs = self.obs
+        phaseprof = self.phaseprof
         S = self.S
         mode = self.compact
         vals_dt = self.pool_fields[0][1]
@@ -178,10 +187,17 @@ class _ResidentProgram:
 
         # tts-lint: traced (returned to lax.while_loop via loop_fns)
         def body(carry):
-            if obs:
-                pool_vals, pool_aux, size, best, tree, sol, cycles, ctr = carry
-            else:
-                pool_vals, pool_aux, size, best, tree, sol, cycles = carry
+            pool_vals, pool_aux, size, best, tree, sol, cycles = carry[:7]
+            ctr = carry[7] if obs else None
+            ph = carry[-1] if phaseprof else None
+            if phaseprof:
+                # Cycle start: the gap since the previous boundary (cond +
+                # carry plumbing, or the pre-loop seed) is `loop` time;
+                # the reading stored here is the cycle's t0 for `total`.
+                ph, (pool_vals, pool_aux, size) = obs_phases.boundary(
+                    ph, "loop", pool_vals, pool_aux, size
+                )
+                t_cycle0 = ph[obs_phases.TPREV]
             cnt = jnp.minimum(size, M)
             start = size - cnt
             start2 = jnp.clip(start, 0, C - M)
@@ -191,12 +207,24 @@ class _ResidentProgram:
             vals_c = vals8_c.astype(jnp.int32)
             aux_c = lax.dynamic_slice(pool_aux, (start2,), (M,)).astype(jnp.int32)
             size = size - cnt
+            if phaseprof:
+                ph, (vals8_c, vals_c, aux_c, size, valid) = obs_phases.boundary(
+                    ph, "pop", vals8_c, vals_c, aux_c, size, valid
+                )
 
             keep, sol_inc, best = evaluate(vals_c, aux_c, valid, best)
             d = swap_of(aux_c)  # (M,) swap position per parent
+            if phaseprof:
+                ph, (keep, sol_inc, best, d) = obs_phases.boundary(
+                    ph, "eval", keep, sol_inc, best, d
+                )
 
             ids, tree_inc = _compact_ids(keep, S, mode)
             fits = tree_inc <= S
+            if phaseprof:
+                ph, (ids, tree_inc, fits) = obs_phases.boundary(
+                    ph, "compact", ids, tree_inc, fits
+                )
 
             def small(pool_vals, pool_aux):
                 # Fused prune+push: ONE gather of the survivor budget —
@@ -276,6 +304,19 @@ class _ResidentProgram:
 
             pool_vals, pool_aux = lax.cond(fits, small, big, pool_vals, pool_aux)
             size = size + tree_inc
+            if phaseprof:
+                # The cond ran exactly one branch: charge its time to the
+                # slot the predicate names, then close the cycle's total
+                # (`pop+eval+compact+push+overflow == total` telescopes).
+                slot = jnp.where(
+                    fits,
+                    jnp.int32(obs_phases.IDX["push"]),
+                    jnp.int32(obs_phases.IDX["overflow"]),
+                )
+                ph, (pool_vals, pool_aux, size) = obs_phases.boundary(
+                    ph, slot, pool_vals, pool_aux, size, tag="push"
+                )
+                ph = obs_phases.close_total(ph, t_cycle0)
             out = (
                 pool_vals, pool_aux, size, best,
                 tree + tree_inc, sol + sol_inc, cycles + 1,
@@ -289,7 +330,9 @@ class _ResidentProgram:
                 ctr = obs_counters.update(
                     ctr, cnt, n, tree_inc, sol_inc, fits, size, push_rows
                 )
-                return out + (ctr,)
+                out = out + (ctr,)
+            if phaseprof:
+                out = out + (ph,)
             return out
 
         # tts-lint: traced (returned to lax.while_loop via loop_fns)
@@ -306,12 +349,17 @@ class _ResidentProgram:
 
         cond, body = self.loop_fns()
         obs = self.obs
+        phaseprof = self.phaseprof
 
         def step(pool_vals, pool_aux, size, best):
             zero = jnp.int32(0)
             init = (pool_vals, pool_aux, size, best, zero, zero, zero)
             if obs:
                 init = init + (obs_counters.init_block(),)
+            if phaseprof:
+                # Pre-loop clock seed: base of the first cycle's `loop`
+                # delta (dep on `size` orders it after the inputs).
+                init = init + (obs_phases.seed_block(size.astype(jnp.uint32)),)
             return lax.while_loop(cond, body, init)
 
         return jax.jit(step, donate_argnums=(0, 1))
@@ -361,18 +409,23 @@ class _ResidentProgram:
         return (int(out[4]), int(out[5]), int(out[6]),
                 int(out[2]), int(out[3]), ctr)
 
+    def read_phase_block(self, out):
+        """The dispatch's harvested phase-clock block (np array) when the
+        profiler variant is armed, else None — same dispatch-boundary
+        readback contract as ``read_scalars`` (the block is the final,
+        non-donated output leaf)."""
+        return np.asarray(out[-1]) if self.phaseprof else None
+
     def read(self, out):
         """Blocks on the step result; returns ``(state, tree, sol, cycles,
         ctr)`` where ``ctr`` is the harvested counter block (np array) when
         device counters are on, else None. The reads happen at the dispatch
         boundary, outside the steady-state guard — the same sanctioned
         scalar readback the engine always performed."""
-        if self.obs:
-            *state, tree, sol, cycles, ctr = out
-            return (tuple(state), int(tree), int(sol), int(cycles),
-                    np.asarray(ctr))
-        *state, tree, sol, cycles = out
-        return tuple(state), int(tree), int(sol), int(cycles), None
+        state = tuple(out[:4])
+        tree, sol, cycles = int(out[4]), int(out[5]), int(out[6])
+        ctr = np.asarray(out[7]) if self.obs else None
+        return state, tree, sol, cycles, ctr
 
     def residual(self, state) -> tuple[dict, int, int]:
         """Downloads the remaining pool -> (host NodeBatch, size, best)."""
@@ -553,9 +606,11 @@ def _make_program(
 
     key = (m, M, K, capacity, id(device), mp_axis, mp_size, allow_staged,
            routing_cache_token(problem, device),
-           # Counter-block programs are distinct compilations: flipping
-           # TTS_OBS between searches must rebuild, not reuse.
-           obs_counters.device_counters_enabled())
+           # Counter-block / phase-clock programs are distinct
+           # compilations: flipping TTS_OBS or TTS_PHASEPROF between
+           # searches must rebuild, not reuse.
+           obs_counters.device_counters_enabled(),
+           obs_phases.phase_profiling_enabled())
     if key in cache:
         return cache[key]
     if isinstance(problem, PFSPProblem):
@@ -745,15 +800,22 @@ def resident_search(
         return g
 
     ctr_total: dict | None = None
+    ph_total: dict | None = None  # per-phase ns totals (TTS_PHASEPROF=1)
     fb_tree = fb_sol = 0  # overflow-fallback host increments (obs parity)
     prev_best = best
     n_disp = 0  # completed-dispatch sequence (flight-recorder registry)
     queue = DispatchQueue(depth)
+    # Steady-state XLA capture (`tts profile` / --xla-trace): opens after
+    # the first consumed dispatch (compile excluded), closes with phase 2.
+    xwin = obs_phases.XlaTraceWindow("resident")
 
     def obs_result() -> dict | None:
-        return (
-            {"device_counters": ctr_total} if ctr_total is not None else None
-        )
+        parts = {}
+        if ctr_total is not None:
+            parts["device_counters"] = ctr_total
+        if ph_total is not None:
+            parts["device_phases"] = ph_total
+        return parts or None
 
     def enqueue() -> None:
         # Speculative pipelined dispatch: the carry chains device-side from
@@ -769,19 +831,25 @@ def resident_search(
         queue.push(out, t_enq)
 
     def consume(out, t_enq) -> tuple[int, int, int]:
-        nonlocal tree2, sol2, size, best, ctr_total, prev_best, n_disp
+        nonlocal tree2, sol2, size, best, ctr_total, ph_total, prev_best
+        nonlocal n_disp
         t_wait = ev.now_us()
         tree_inc, sol_inc, cycles, size, best, ctr = \
             program.read_scalars(out)
+        phb = program.read_phase_block(out)
         tree2 += tree_inc
         sol2 += sol_inc
         n_disp += 1
         diagnostics.kernel_launches += cycles
         if ctr is not None:
             ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        if phb is not None:
+            ph_total = obs_phases.merge_host(ph_total, phb)
+        xwin.on_dispatch(n_disp)
         fr.heartbeat("resident", seq=n_disp, cycles=cycles, size=size,
                      best=best, tree=tree2, sol=sol2, depth=depth,
-                     K=program.K, inflight=len(queue))
+                     K=program.K, inflight=len(queue),
+                     phases=ph_total)
         if ev.enabled():
             now = ev.now_us()
             # Span semantics under pipelining (docs/OBSERVABILITY.md): the
@@ -797,6 +865,9 @@ def resident_search(
                     })
             if ctr is not None:
                 ev.counter("device_counters", **obs_counters.as_args(ctr))
+            if phb is not None:
+                # One Perfetto counter track per phase (ns this dispatch).
+                ev.counter("device_phases", **obs_phases.as_args(phb))
             if best < prev_best:
                 ev.emit("incumbent", args={"best": best})
         prev_best = best
@@ -846,6 +917,7 @@ def resident_search(
             break
         if controller.after_step(tree1 + tree2, sol1 + sol2):
             drain_queue()  # no-op if the cutoff save already drained
+            xwin.close()
             t2 = time.perf_counter()
             phases.append(PhaseStats(t2 - t1, tree2, sol2))
             ev.emit("checkpoint", args={"cutoff": True})
@@ -864,6 +936,7 @@ def resident_search(
                 k_resolved=program.K,
                 k_auto=k_auto,
                 obs=obs_result(),
+                phase_profile=ph_total,
             )
         if ctl is not None and cycles > 0 and ctl.observe(period, cycles):
             # Geometric-ladder K resize: drain, then swap in the rung's
@@ -920,6 +993,7 @@ def resident_search(
             ev.complete("overflow_fallback", t_fb, args={
                 "tree": tree2 - fb_tree0, "sol": sol2 - fb_sol0,
             })
+    xwin.close()
     batch, size, best = program.residual(state)
     diagnostics.device_to_host += 1
     pool.reset_from(batch)
@@ -948,4 +1022,5 @@ def resident_search(
         k_resolved=program.K,
         k_auto=k_auto,
         obs=obs_result(),
+        phase_profile=ph_total,
     )

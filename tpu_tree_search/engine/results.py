@@ -73,6 +73,14 @@ class SearchResult:
     # pool_hwm, surv_hwm}}). None when obs is off — the default-off path
     # carries no cost and no payload.
     obs: dict | None = None
+    # Per-phase device-time totals in nanoseconds (TTS_PHASEPROF=1 /
+    # `tts profile`, obs/phases.py): {pop, eval, compact, push, overflow,
+    # balance, loop, total} harvested from the armed program variant's
+    # phase-clock block. The in-cycle slots sum to `total` exactly; for
+    # the mesh tiers the values aggregate across shards (shares stay
+    # D-invariant). None when the profiler is off. (`phases` above is the
+    # host-side 3-phase wall-clock breakdown — a different axis.)
+    phase_profile: dict | None = None
 
     def workload_shares(self) -> list[float]:
         """Per-worker share of explored nodes (load-balance report,

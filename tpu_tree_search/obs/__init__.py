@@ -23,6 +23,12 @@ Closed-loop legs (same doc):
   * ``costmodel`` — measured per-link latency+bandwidth profiles
     (``COSTMODEL.json``) that AdaptiveK and the mesh/dist periods resolve
     from (``TTS_COSTMODEL``).
+  * ``phases`` — on-device per-phase cycle clocks (``TTS_PHASEPROF=1`` /
+    ``tts profile``): a barrier-fenced clock block in the resident loop
+    carry decomposing the chunk cycle into pop/eval/compact/push/
+    overflow (+ mesh balance), plus the steady-state XLA trace window
+    (``TTS_XLA_TRACE``). A separate cache-keyed program variant — never
+    the headline program.
 
 Knobs: ``TTS_OBS=1`` (everything), ``TTS_OBS=host`` (host events only —
 device programs untouched), off by default with zero hot-loop cost.
@@ -34,7 +40,7 @@ from __future__ import annotations
 import os
 from contextlib import contextmanager
 
-from . import costmodel, counters, events, export, flightrec, live, report
+from . import costmodel, counters, events, export, flightrec, live, phases, report
 
 __all__ = [
     "capture",
@@ -45,6 +51,7 @@ __all__ = [
     "flightrec",
     "live",
     "obs_enabled",
+    "phases",
     "report",
 ]
 

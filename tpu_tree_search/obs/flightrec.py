@@ -108,7 +108,23 @@ def _aggregate(now: float, tier: str, last: list[dict], idle_count: int,
     nps = 0.0
     if prev is not None and now > prev["ts_us"]:
         nps = max(0.0, (tree - prev["tree"]) * 1e6 / (now - prev["ts_us"]))
+    # Latest harvested phase split (TTS_PHASEPROF runs): the newest
+    # registry entry that carries one names the dominant phase.
+    ph = None
+    for d in sorted(last, key=lambda d: d["ts_us"]):
+        if d.get("phases"):
+            ph = d["phases"]
+    snap_phase: dict = {}
+    if ph is not None:
+        from . import phases as phases_mod
+
+        snap_phase["phases"] = dict(ph)
+        dom = phases_mod.dominant_phase(ph)
+        if dom is not None:
+            snap_phase["dominant_phase"] = dom[0]
+            snap_phase["dominant_phase_share"] = round(dom[1], 4)
     return {
+        **snap_phase,
         "ts_us": now,
         "tier": tier,
         "seq": max((d["seq"] for d in last), default=0),
@@ -156,21 +172,27 @@ class FlightRecorder:
                   seq: int = 0, cycles: int = 0, size: int | None = None,
                   best: int | None = None, tree: int = 0, sol: int = 0,
                   depth: int = 1, K: int | None = None, inflight: int = 0,
-                  steals: int = 0) -> None:
+                  steals: int = 0, phases: dict | None = None) -> None:
         """One completed dispatch/chunk boundary. Updates the registry,
         feeds the watchdog, and (rate-limited) appends a ring snapshot +
-        emits a ``snapshot`` counter sample into the event stream."""
+        emits a ``snapshot`` counter sample into the event stream.
+        ``phases`` is the run's per-phase ns totals so far (TTS_PHASEPROF
+        armed runs) — a watchdog post-mortem then names where the last
+        dispatch was spending its cycles."""
         if not enabled():
             return
         now = ev.now_us()
         self._last_beat = time.monotonic()
         self._stall_dumped = False
         with self._lock:
-            self._last[(host, wid)] = {
+            entry = {
                 "ts_us": now, "seq": seq, "cycles": cycles, "size": size,
                 "best": best, "tree": tree, "sol": sol, "inflight": inflight,
                 "steals": steals,
             }
+            if phases is not None:
+                entry["phases"] = dict(phases)
+            self._last[(host, wid)] = entry
             self._idle.discard((host, wid))
             self._meta.setdefault("tier", tier)
             self._meta["depth"] = depth

@@ -154,6 +154,10 @@ def format_snapshot(snap: dict) -> str:
         )
     if snap.get("steals"):
         parts.append(f"steals={snap['steals']}")
+    if snap.get("dominant_phase"):
+        # TTS_PHASEPROF runs: where the last dispatch spent its cycles.
+        share = snap.get("dominant_phase_share", 0.0)
+        parts.append(f"phase={snap['dominant_phase']}:{100.0 * share:.0f}%")
     parts.append(f"dispatch#{snap.get('seq', 0)}")
     return "  ".join(parts)
 

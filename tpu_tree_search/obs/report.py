@@ -135,6 +135,21 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
                 else:
                     counters_total[k] = counters_total.get(k, 0) + v
 
+    # -- per-phase cycle decomposition (TTS_PHASEPROF, obs/phases.py) ------
+    # device_phases counter samples carry per-dispatch nanoseconds per
+    # phase; their sum is the run's measured on-device cycle split.
+    phases_total: dict = {}
+    for e in evts:
+        if e.get("name") == "device_phases":
+            for k, v in (e.get("args") or {}).items():
+                if isinstance(v, (int, float)):
+                    phases_total[k] = phases_total.get(k, 0) + v
+    phase_decomp = None
+    if phases_total.get("total"):
+        from . import phases as phases_mod
+
+        phase_decomp = phases_mod.decomp(phases_total)
+
     # -- survivor-path work split (maintenance vs evaluator) ---------------
     # The resident cycle does two kinds of work: the evaluator bounds every
     # candidate child (pushed + leaves + pruned evaluations), and the
@@ -168,7 +183,49 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
         "cycle_rate": timeline,
         "device_counters": counters_total,
         "survivor_path": survivor,
+        "phase_decomp": phase_decomp,
     }
+
+
+#: Human names for the phase slots (the decomposition table + the
+#: "next structural cost" line use these, not the internal slugs).
+_PHASE_LABELS = {
+    "pop": "pop/select",
+    "eval": "bound evaluation",
+    "compact": "compaction",
+    "push": "fused prune+push",
+    "overflow": "overflow branch",
+    "balance": "steal/exchange (mesh)",
+    "loop": "loop overhead",
+}
+
+
+def phase_table(decomp: dict) -> list[str]:
+    """The ``tts report`` / ``tts profile`` decomposition table: one line
+    per phase (measured device ns + share of the cycle), closed by the
+    dominant-phase call-out — the "measured cycle decomposition naming
+    the next structural cost" deliverable of ROADMAP item 1."""
+    ns = decomp.get("ns", {})
+    sh = decomp.get("shares", {})
+    out = ["phase decomposition (on-device cycle clocks, ns):"]
+    for slot in ("pop", "eval", "compact", "push", "overflow"):
+        out.append(
+            f"  {_PHASE_LABELS[slot]:<22} {ns.get(slot, 0):>14,}  "
+            f"{100.0 * sh.get(slot, 0.0):5.1f}% of cycle"
+        )
+    out.append(f"  {'cycle total':<22} {ns.get('total', 0):>14,}")
+    for slot in ("balance", "loop"):
+        if ns.get(slot):
+            out.append(
+                f"  {_PHASE_LABELS[slot]:<22} {ns.get(slot, 0):>14,}  "
+                "(outside the cycle)"
+            )
+    if decomp.get("dominant"):
+        out.append(
+            f"  next structural cost: {_PHASE_LABELS[decomp['dominant']]}, "
+            f"{100.0 * decomp.get('dominant_share', 0.0):.0f}% of cycle"
+        )
+    return out
 
 
 def render(summary: dict) -> str:
@@ -217,6 +274,8 @@ def render(summary: dict) -> str:
             "device counters: "
             + "  ".join(f"{k}={v}" for k, v in sorted(c.items()))
         )
+    if summary.get("phase_decomp"):
+        out.extend(phase_table(summary["phase_decomp"]))
     if summary.get("survivor_path"):
         sp = summary["survivor_path"]
         out.append(

@@ -42,6 +42,7 @@ from ..engine.results import Diagnostics, PhaseStats, SearchResult
 from ..obs import counters as obs_counters
 from ..obs import events as ev
 from ..obs import flightrec as fr
+from ..obs import phases as obs_phases
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
 from .dist import (
@@ -194,10 +195,14 @@ def _host_loop(
     per_worker = np.zeros(D, dtype=np.int64)
 
     ctr_total: dict | None = None
+    ph_total: dict | None = None  # per-phase ns totals (TTS_PHASEPROF=1)
     prev_best = best
     sizes = np.zeros(D, dtype=np.int32)
     n_disp = 0  # completed-dispatch sequence (flight-recorder registry)
     queue = DispatchQueue(depth)
+    # Steady-state XLA capture: the jax profiler is process-global, so
+    # only one virtual host's window arms (XlaTraceWindow's active guard).
+    xwin = obs_phases.XlaTraceWindow("dist_mesh")
     last_ready = time.monotonic()
 
     def enqueue() -> None:
@@ -209,10 +214,11 @@ def _host_loop(
         queue.push(out, t_enq)
 
     def consume(out, t_enq) -> tuple[int, int, int]:
-        nonlocal tree2, sol2, sizes, best, ctr_total, prev_best, per_worker
-        nonlocal n_disp
+        nonlocal tree2, sol2, sizes, best, ctr_total, ph_total, prev_best
+        nonlocal per_worker, n_disp
         t_wait = ev.now_us()
         ti, si, cy, sizes, best, tree_vec, ctr = program.read_scalars(out)
+        phb = program.read_phase_block(out)
         tree2 += ti
         sol2 += si
         n_disp += 1
@@ -220,10 +226,13 @@ def _host_loop(
         diagnostics.kernel_launches += cy
         if ctr is not None:
             ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        if phb is not None:
+            ph_total = obs_phases.merge_host(ph_total, phb)
+        xwin.on_dispatch(n_disp)
         fr.heartbeat("dist_mesh", host=me, seq=n_disp, cycles=cy,
                      size=int(sizes.sum()), best=int(best), tree=tree2,
                      sol=sol2, depth=depth, K=program.K,
-                     inflight=len(queue))
+                     inflight=len(queue), phases=ph_total)
         if ev.enabled():
             now = ev.now_us()
             ev.emit("dispatch", ph="X", ts=t_enq, host=me,
@@ -237,6 +246,9 @@ def _host_loop(
             if ctr is not None:
                 ev.counter("device_counters", host=me,
                            **obs_counters.as_args(ctr))
+            if phb is not None:
+                ev.counter("device_phases", host=me,
+                           **obs_phases.as_args(phb))
             if best < prev_best:
                 ev.emit("incumbent", host=me, args={"best": int(best)})
         prev_best = best
@@ -454,6 +466,7 @@ def _host_loop(
 
     # -- phase 3: local residual drain --------------------------------------
     drain_queue()  # remaining speculative dispatches are no-ops by now
+    xwin.close()
     batch = program.residual_batch(state)
     diagnostics.device_to_host += 1
     pool.reset_from(batch)
@@ -495,8 +508,16 @@ def _host_loop(
         "k_auto": k_auto,
         # Host-local counter totals (not reduced — per-host telemetry).
         "obs": (
-            {"device_counters": ctr_total} if ctr_total is not None else None
+            {
+                **({"device_counters": ctr_total}
+                   if ctr_total is not None else {}),
+                **({"device_phases": ph_total}
+                   if ph_total is not None else {}),
+            }
+            if (ctr_total is not None or ph_total is not None) else None
         ),
+        # Host-local per-phase ns totals (TTS_PHASEPROF=1, obs/phases.py).
+        "phase_profile": ph_total,
     }
 
 
@@ -519,6 +540,7 @@ def _reduce(local: dict, coll) -> SearchResult:
         k_resolved=local.get("k_resolved"),
         k_auto=local.get("k_auto", False),
         obs=local.get("obs"),
+        phase_profile=local.get("phase_profile"),
     )
 
 

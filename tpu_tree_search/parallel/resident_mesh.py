@@ -46,6 +46,7 @@ from ..engine.results import Diagnostics, PhaseStats, SearchResult
 from ..obs import counters as obs_counters
 from ..obs import events as ev
 from ..obs import flightrec as fr
+from ..obs import phases as obs_phases
 from ..ops import pallas_kernels as PK
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, index_batch
@@ -149,6 +150,7 @@ class _MeshResidentProgram:
         cond, body = self.inner.loop_fns(K)
         rounds = self.rounds
         obs = self.inner.obs
+        phaseprof = self.inner.phaseprof
         perm = [(i, (i + 1) % D) for i in range(D)]  # ring, static
 
         def shard_step(pool_vals, pool_aux, size, best):
@@ -164,18 +166,36 @@ class _MeshResidentProgram:
                 # Counter block accumulates across the dispatch's rounds
                 # (carried back in each round); varying like the scalars.
                 ctr = obs_counters.init_block() + (sz * 0)
+            if phaseprof:
+                # Phase-clock block (obs/phases.py): seeded once per
+                # dispatch, accumulated across the rounds; varying like
+                # the scalars (the callback clock runs per shard).
+                ph = obs_phases.seed_block(
+                    sz.astype(jnp.uint32)
+                ) + (sz * 0).astype(jnp.uint32)
             for _ in range(rounds):
                 init = (pool_vals, pool_aux, sz, bst, sz * 0, sz * 0, sz * 0)
                 if obs:
                     init = init + (ctr,)
+                if phaseprof:
+                    init = init + (ph,)
                 carry = lax.while_loop(cond, body, init)
+                pool_vals, pool_aux, sz, bst, ti, si, cy = carry[:7]
                 if obs:
-                    pool_vals, pool_aux, sz, bst, ti, si, cy, ctr = carry
-                else:
-                    pool_vals, pool_aux, sz, bst, ti, si, cy = carry
+                    ctr = carry[7]
+                if phaseprof:
+                    ph = carry[-1]
                 tree += ti
                 sol += si
                 cycles += cy
+                if phaseprof:
+                    # Loop exit -> balance section: the gap (cond fails,
+                    # carry unwinds) is `loop` time; the pmin fold + the
+                    # diffusion round below are charged to `balance`.
+                    ph, (pool_vals, pool_aux, sz, bst) = obs_phases.boundary(
+                        ph, "loop", pool_vals, pool_aux, sz, bst,
+                        tag="mesh_loop",
+                    )
                 # Incumbent all-reduce over ICI (north-star improvement).
                 # pcast re-marks the reduced (axis-invariant) value as
                 # varying so the next round's while-loop carry types match
@@ -242,6 +262,12 @@ class _MeshResidentProgram:
                         pool_vals, pool_aux,
                     )
                     sz = sz + incoming
+                if phaseprof:
+                    # Close the balance segment (incumbent fold + ppermute
+                    # diffusion — the mesh tiers' steal/exchange phase).
+                    ph, (pool_vals, pool_aux, sz, bst) = obs_phases.boundary(
+                        ph, "balance", pool_vals, pool_aux, sz, bst,
+                    )
             out = (
                 pool_vals,
                 pool_aux,
@@ -253,6 +279,8 @@ class _MeshResidentProgram:
             )
             if obs:
                 out = out + (ctr[None],)
+            if phaseprof:
+                out = out + (ph[None],)
             return out
 
         specs_pool = P(axis, None)
@@ -262,6 +290,8 @@ class _MeshResidentProgram:
             specs_vec, specs_vec, specs_vec,
         )
         if obs:
+            out_specs = out_specs + (P(axis, None),)
+        if phaseprof:
             out_specs = out_specs + (P(axis, None),)
         mapped = jax_compat.shard_map(
             shard_step,
@@ -375,10 +405,8 @@ class _MeshResidentProgram:
         dispatch they were already donated into the next speculative
         dispatch. ``sizes``/``best`` are (D,) vectors carried outside the
         donation set."""
-        if self.inner.obs:
-            tree, sol, cycles, ctr = out[4], out[5], out[6], np.asarray(out[7])
-        else:
-            tree, sol, cycles, ctr = out[4], out[5], out[6], None
+        tree, sol, cycles = out[4], out[5], out[6]
+        ctr = np.asarray(out[7]) if self.inner.obs else None
         sizes = np.asarray(out[2])
         best = int(np.asarray(out[3]).min())
         return (
@@ -390,6 +418,12 @@ class _MeshResidentProgram:
             np.asarray(tree),
             ctr,
         )
+
+    def read_phase_block(self, out):
+        """The dispatch's harvested (D, NSLOTS+1) phase-clock block (np
+        array) when the profiler variant is armed, else None — the final,
+        non-donated output leaf (same readback contract as the scalars)."""
+        return np.asarray(out[-1]) if self.inner.phaseprof else None
 
     def read_stats(self, out):
         """(state, tree, sol, cycles, sizes, best, tree_vec, ctr) — the
@@ -442,6 +476,7 @@ def get_mesh_program(problem, mesh, m: int, M: int, K: int, rounds: int,
         m, M, K, rounds, T, capacity,
         routing_cache_token(problem, mesh.devices.flat[0]),
         obs_counters.device_counters_enabled(),
+        obs_phases.phase_profiling_enabled(),
     )
     program = cache.get(key)
     if program is None:
@@ -589,15 +624,20 @@ def mesh_resident_search(
         return g
 
     ctr_total: dict | None = None
+    ph_total: dict | None = None  # per-phase ns totals (TTS_PHASEPROF=1)
     fb_tree = fb_sol = 0  # saturation-fallback host increments (obs parity)
     prev_best = best
     n_disp = 0  # completed-dispatch sequence (flight-recorder registry)
     queue = DispatchQueue(depth)
+    xwin = obs_phases.XlaTraceWindow("mesh")
 
     def obs_result() -> dict | None:
-        return (
-            {"device_counters": ctr_total} if ctr_total is not None else None
-        )
+        parts = {}
+        if ctr_total is not None:
+            parts["device_counters"] = ctr_total
+        if ph_total is not None:
+            parts["device_phases"] = ph_total
+        return parts or None
 
     def enqueue() -> None:
         nonlocal state
@@ -608,10 +648,11 @@ def mesh_resident_search(
         queue.push(out, t_enq)
 
     def consume(out, t_enq) -> tuple[int, int, int]:
-        nonlocal tree2, sol2, sizes, best, ctr_total, prev_best, per_worker
-        nonlocal n_disp
+        nonlocal tree2, sol2, sizes, best, ctr_total, ph_total, prev_best
+        nonlocal per_worker, n_disp
         t_wait = ev.now_us()
         ti, si, cy, sizes, best, tree_vec, ctr = program.read_scalars(out)
+        phb = program.read_phase_block(out)
         tree2 += ti
         sol2 += si
         n_disp += 1
@@ -619,9 +660,13 @@ def mesh_resident_search(
         diagnostics.kernel_launches += cy
         if ctr is not None:
             ctr_total = obs_counters.merge_host(ctr_total, ctr)
+        if phb is not None:
+            ph_total = obs_phases.merge_host(ph_total, phb)
+        xwin.on_dispatch(n_disp)
         fr.heartbeat("mesh", seq=n_disp, cycles=cy, size=int(sizes.sum()),
                      best=best, tree=tree2, sol=sol2, depth=depth,
-                     K=program.K, inflight=len(queue))
+                     K=program.K, inflight=len(queue),
+                     phases=ph_total)
         if ev.enabled():
             now = ev.now_us()
             ev.emit("dispatch", ph="X", ts=t_enq,
@@ -634,6 +679,8 @@ def mesh_resident_search(
                     })
             if ctr is not None:
                 ev.counter("device_counters", **obs_counters.as_args(ctr))
+            if phb is not None:
+                ev.counter("device_phases", **obs_phases.as_args(phb))
             if best < prev_best:
                 ev.emit("incumbent", args={"best": best})
         prev_best = best
@@ -680,6 +727,7 @@ def mesh_resident_search(
             break
         if controller.after_step(tree1 + tree2, sol1 + sol2):
             drain_queue()  # no-op if the cutoff save already drained
+            xwin.close()
             t2 = time.perf_counter()
             phases.append(PhaseStats(t2 - t1, tree2, sol2))
             ev.emit("checkpoint", args={"cutoff": True})
@@ -699,6 +747,7 @@ def mesh_resident_search(
                 k_resolved=program.K,
                 k_auto=k_auto,
                 obs=obs_result(),
+                phase_profile=ph_total,
             )
         if ctl is not None and cy > 0 and ctl.observe(period, cy):
             drain_queue()
@@ -757,6 +806,7 @@ def mesh_resident_search(
             prev_sizes = None
             continue
         prev_sizes = sizes
+    xwin.close()
     batch = program.residual_batch(state)
     diagnostics.device_to_host += 1
     pool.reset_from(batch)
@@ -784,4 +834,5 @@ def mesh_resident_search(
         k_resolved=program.K,
         k_auto=k_auto,
         obs=obs_result(),
+        phase_profile=ph_total,
     )
