@@ -165,6 +165,24 @@ def roofline(nps: float, n: int, m: int, P: int | None, lb: str,
     }
 
 
+def contracts_fingerprint() -> str | None:
+    """The committed compiled-program contract fingerprint
+    (`.tts-contracts.json`, ISSUE 8): recorded in every bench artifact so
+    a banked perf number is tied to the exact program STRUCTURE it
+    measured — a later `tts check --update` (reviewed drift) makes old
+    rows distinguishable from new ones at a glance."""
+    try:
+        from tpu_tree_search.analysis.program_audit import (
+            committed_fingerprint,
+        )
+
+        return committed_fingerprint(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".tts-contracts.json"
+        ))
+    except Exception:  # noqa: BLE001 — provenance must never break a row
+        return None
+
+
 def _git_head() -> str:
     try:
         out = subprocess.run(
@@ -188,6 +206,7 @@ def record_last_good(record: dict) -> None:
                 "vs_ref_c_seq": record.get("vs_ref_c_seq"),
                 "pallas": record.get("pallas", False),
                 "compact": record.get("compact", {}).get("picked"),
+                "contracts": record.get("contracts"),
                 "commit": _git_head(),
                 "date": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
             }, f, indent=1)
@@ -237,6 +256,9 @@ class BenchPartial:
             "rc": None,
             "started": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
             "commit": _git_head(),
+            # Program-structure provenance: the committed contract
+            # fingerprint every row in this document was measured under.
+            "contracts": contracts_fingerprint(),
             "rows": [],
         }
         self._index: dict[str, int] = {}
@@ -1128,6 +1150,7 @@ def _main(partial: BenchPartial) -> int:
             "vs_baseline": 0.0,
             "parity": False,
             "error": alive_err,
+            "contracts": contracts_fingerprint(),
             "pallas": False,
             # The TPU is unreachable, but the host-runtime comparison needs
             # no TPU — an outage round still banks measured numbers.
@@ -1393,6 +1416,9 @@ def _main(partial: BenchPartial) -> int:
     if express:
         record["express"] = True
     record["backend"] = jax.default_backend()
+    # Provenance: the contract fingerprint this number was measured under
+    # (ties the row to the exact compiled-program structure — ISSUE 8).
+    record["contracts"] = contracts_fingerprint()
     record["pallas"] = pallas_ok
     if pallas_err:
         record["pallas_error"] = pallas_err

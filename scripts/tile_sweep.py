@@ -35,7 +35,6 @@ ENV_BY_KERNEL = {
 
 
 def run_cell(kernel: str, tile: int, batch: int, inst: int, reps: int = 20):
-    import jax
     import jax.numpy as jnp
     import numpy as np
 

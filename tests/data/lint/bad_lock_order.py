@@ -1,0 +1,42 @@
+"""Known-bad fixture for the ``lock-order`` audit: an A->B / B->A blocking
+cycle (deadlock potential) and a blocking same-class re-acquisition (must
+be try_lock).  Every guarded access holds its own lock, so only the
+lock-order rule fires here."""
+
+import threading
+
+
+class A:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.x = 0  # guarded-by: lock
+
+
+class B:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.y = 0  # guarded-by: lock
+
+
+def ab(a: A, b: B):
+    with a.lock:
+        with b.lock:
+            b.y = 1
+
+
+def ba(a: A, b: B):
+    with b.lock:
+        with a.lock:  # closes the A->B->A cycle
+            a.x = 1
+
+
+def same_class(p: A, q: A):
+    with p.lock:
+        with q.lock:  # blocking same-class: must be try_lock
+            q.x = 2
+
+
+def sanctioned(p: A, q: A):
+    with p.lock:
+        if q.try_lock():  # non-blocking probe: the steal discipline — OK
+            q.x = 3

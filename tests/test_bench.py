@@ -102,6 +102,24 @@ def test_record_last_good_roundtrip(tmp_path, monkeypatch):
     assert bench.last_good()["compact"] is None
 
 
+def test_contracts_fingerprint_provenance(tmp_path, monkeypatch):
+    """ISSUE 8 satellite: every bench artifact records the committed
+    compiled-program contract fingerprint, so a banked number is tied to
+    the exact program structure it measured."""
+    fp = bench.contracts_fingerprint()
+    assert fp, "committed .tts-contracts.json missing or unreadable"
+    monkeypatch.setenv("TTS_BENCH_PARTIAL", str(tmp_path / "p.json"))
+    partial = bench.BenchPartial()
+    assert partial.doc["contracts"] == fp
+    with open(tmp_path / "p.json") as f:
+        assert json.load(f)["contracts"] == fp
+    # last-good rows carry it too
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "lg.json"))
+    bench.record_last_good({"metric": "m", "value": 1.0, "vs_baseline": 1.0,
+                            "contracts": fp})
+    assert bench.last_good()["contracts"] == fp
+
+
 def test_host_seq_parses_partial_rows(monkeypatch):
     """A timeout must keep the rows that already streamed (round-5
     contract: finished measurements survive)."""

@@ -1,24 +1,25 @@
 """Survivor-path overhaul (`ops/compaction.py` + the fused prune+push in
 `engine/resident.py`): dense-path bit-exactness against the scatter oracle,
-the jaxpr pins the acceptance criteria demand (dense programs free of
+the structural pins the acceptance criteria demand (dense programs free of
 sort/scatter; at most ONE child-value-sized gather per cycle in every
-mode), the auto policy, and the push_rows telemetry."""
+mode) — routed through the contract registry (`tts check`,
+analysis/contracts.py) since ISSUE 8, so the same claims are also checked
+over the whole knob matrix — plus the auto policy and the push_rows
+telemetry."""
 
 from __future__ import annotations
 
 import numpy as np
 import pytest
 
-from tpu_tree_search.engine.resident import (
-    _compact_ids,
-    _make_program,
-    resident_search,
-    resolve_capacity,
-)
+from tpu_tree_search.analysis import contracts, program_audit
+from tpu_tree_search.engine.resident import resident_search
 from tpu_tree_search.engine.sequential import sequential_search
 from tpu_tree_search.ops import compaction
 from tpu_tree_search.problems import NQueensProblem, PFSPProblem
 from tpu_tree_search.problems.pfsp import taillard
+
+program_audit.load_contracts()
 
 
 # -- dense ids vs the scatter oracle ---------------------------------------
@@ -65,117 +66,51 @@ def test_dense_ids_edge_masks():
         np.testing.assert_array_equal(ids_d[:inc], ref)
 
 
-# -- jaxpr pins -------------------------------------------------------------
+# -- structural pins: routed through the contract registry (ISSUE 8) -------
+# The claims below are Contracts declared in ops/compaction.py and
+# engine/resident.py and checked over the WHOLE knob matrix by `tts
+# check`; these tests exercise the same registry entries on the cells
+# this file historically guarded, so a local run still fails fast.
 
 
-def _prim_names(jaxpr, out=None):
-    """Every primitive name in a (closed) jaxpr, recursing into sub-jaxprs
-    (while/cond/scan/pjit bodies)."""
-    if out is None:
-        out = []
-    for eqn in jaxpr.eqns:
-        out.append((eqn.primitive.name, eqn))
-        for v in eqn.params.values():
-            for sub in _as_jaxprs(v):
-                _prim_names(sub, out)
-    return out
-
-
-def _as_jaxprs(v):
-    from jax.core import ClosedJaxpr, Jaxpr
-
-    if isinstance(v, Jaxpr):
-        return [v]
-    if isinstance(v, ClosedJaxpr):
-        return [v.jaxpr]
-    if isinstance(v, (list, tuple)):
-        return [j for x in v for j in _as_jaxprs(x)]
-    return []
-
-
-def _step_prims(problem, M, K=4, monkeypatch=None, mode=None):
-    import jax
-
-    if mode is not None:
-        monkeypatch.setenv("TTS_COMPACT", mode)
-    capacity, M = resolve_capacity(problem, M, None)
-    prog = _make_program(problem, 5, M, K, capacity, jax.devices()[0])
-    state = prog.init_state({}, getattr(problem, "initial_ub", 0))
-    jaxpr = jax.make_jaxpr(prog._step)(*state)
-    return prog, _prim_names(jaxpr.jaxpr)
-
-
-@pytest.mark.parametrize("mk", [
-    lambda: NQueensProblem(N=9),
-    lambda: PFSPProblem(lb="lb1", ub=0,
-                        p_times=taillard.reduced_instance(14, 10, 5)),
-])
-def test_dense_step_jaxpr_free_of_sort_scatter(mk, monkeypatch):
+@pytest.mark.parametrize("family", ["nqueens", "pfsp-lb1"])
+def test_dense_step_contract_free_of_sort_scatter(family):
     """The acceptance pin: under TTS_COMPACT=dense the WHOLE compiled step
-    — compaction, fused push, and the overflow fallback branch — contains
-    no sort, no scatter, and no searchsorted (searchsorted has no
-    primitive of its own; banning sort+scatter plus the compact_ids-level
-    gather pin below covers every implementation it could lower to)."""
-    _, prims = _step_prims(mk(), 128, monkeypatch=monkeypatch, mode="dense")
-    names = {n for n, _ in prims}
-    assert not any(n.startswith("scatter") for n in names), names
-    assert "sort" not in names, names
+    — compaction, fused push, and the overflow fallback branch — adds no
+    sort and no scatter beyond the bare evaluator's own ops."""
+    cell = program_audit.Cell(family, compact="dense")
+    art = program_audit.trace_cell(cell)
+    assert art.prog.compact == "dense"
+    assert contracts.run_one("dense-step-no-sort-scatter", art, cell) == []
 
 
-def test_dense_compact_ids_jaxpr_gather_free(monkeypatch):
-    """The dense rank inversion itself is pure shifts + selects: no sort,
-    no scatter, and not even a gather (the fused write performs the
-    cycle's single gather)."""
-    import jax
-
-    jaxpr = jax.make_jaxpr(
-        lambda k: compaction.compact_ids(k, 640, "dense")
-    )(np.zeros((64, 20), bool))
-    names = {n for n, _ in _prim_names(jaxpr.jaxpr)}
-    for banned in ("sort", "gather"):
-        assert banned not in names, names
-    assert not any(n.startswith("scatter") for n in names), names
+def test_compact_ids_contracts():
+    """The dense rank inversion is pure shifts + selects (no sort, no
+    scatter, not even a gather) and the scatter inversion's one scatter
+    is genuinely unique-indexed — both registry entries, all modes."""
+    findings = program_audit.audit_compact_ids()
+    assert findings == [], [f.render() for f in findings]
 
 
 @pytest.mark.parametrize("mode", ["scatter", "sort", "search", "dense"])
-def test_fused_push_single_child_value_gather(mode, monkeypatch):
-    """Op-count pin for the fused prune+push: in EVERY mode the compiled
-    step contains at most one gather big enough to be moving child values
-    (>= S rows of n lanes) — the single augmented (row, aux) gather of the
+def test_fused_push_single_child_value_gather(mode):
+    """Op-count pin for the fused prune+push: in EVERY mode at most one
+    gather big enough to be moving child values (>= S rows of n lanes in
+    the pool value dtype) — the single augmented (row, aux) gather of the
     fused write.  The pre-fusion body gathered rows, both swap lanes, and
     aux separately."""
-    prob = PFSPProblem(lb="lb1", ub=0,
-                       p_times=taillard.reduced_instance(14, 10, 5))
-    prog, prims = _step_prims(prob, 128, monkeypatch=monkeypatch, mode=mode)
-    n = prob.child_slots
-    vals_dt = np.dtype(prog.pool_fields[0][1])
-    # "Child values" = pool-value-dtype rows; the search mode additionally
-    # gathers (S, n) keep/lane MASKS by design, which move no node data.
-    big = [
-        eqn for name, eqn in prims
-        if name == "gather"
-        and any(v.aval.size >= prog.S * n and v.aval.dtype == vals_dt
-                for v in eqn.outvars)
-    ]
-    assert len(big) <= 1, (mode, [str(e) for e in big])
+    cell = program_audit.Cell("pfsp-lb1", compact=mode)
+    art = program_audit.trace_cell(cell)
+    assert contracts.run_one("fused-push-single-gather", art, cell) == []
 
 
-def test_auto_resolves_identically_to_explicit(monkeypatch):
+def test_auto_resolves_identically_to_explicit():
     """TTS_COMPACT=auto must bake in the same program as the explicitly
     spelled mode it resolves to — byte-identical jaxpr, so the policy
     layer adds zero behavior of its own."""
-    import jax
-
-    def jaxpr_text(mode):
-        monkeypatch.setenv("TTS_COMPACT", mode)
-        prob = NQueensProblem(N=8)  # fresh instance: no cached programs
-        capacity, M = resolve_capacity(prob, 64, None)
-        prog = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
-        assert prog.compact == "dense"  # the policy pick for N-Queens
-        state = prog.init_state({}, 0)
-        return str(jax.make_jaxpr(prog._step)(*state))
-
-    assert jaxpr_text("auto") == jaxpr_text("dense")
+    art = program_audit.variant_artifact("nqueens", labels=["compact-auto"])
+    assert "compact-dense" in art.variants  # the policy pick for N-Queens
+    assert contracts.run_one("compact-auto-identity", art) == []
 
 
 # -- auto policy ------------------------------------------------------------

@@ -91,55 +91,34 @@ def test_multi_counter_parity():
     assert (res.explored_tree, res.explored_sol) == (2056, 92)
 
 
-# -- zero-cost disabled path ----------------------------------------------
+# -- zero-cost disabled path (routed through the contract registry) --------
+# The byte-identity and cache-key claims are Contracts (obs/counters.py,
+# engine/resident.py) checked over the whole knob matrix by `tts check`;
+# these tests pin the same registry entries on the historical cell.
 
 
-def _resident_step_jaxpr(monkeypatch, obs: str | None) -> tuple[str, int]:
-    """(jaxpr text, n_outvars) of a freshly built resident step."""
-    import jax
+def test_disabled_mode_jaxpr_identical_and_counter_free():
+    from tpu_tree_search.analysis import contracts, program_audit
 
-    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
-
-    if obs is None:
-        monkeypatch.delenv("TTS_OBS", raising=False)
-    else:
-        monkeypatch.setenv("TTS_OBS", obs)
-    prob = NQueensProblem(N=8)  # fresh instance: no cached programs
-    capacity, M = resolve_capacity(prob, 64, None)
-    prog = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
-    state = prog.init_state({}, 0)
-    jaxpr = jax.make_jaxpr(prog._step)(*state)
-    return str(jaxpr), len(jaxpr.jaxpr.outvars)
-
-
-def test_disabled_mode_jaxpr_identical_and_counter_free(monkeypatch):
-    off1, n_off1 = _resident_step_jaxpr(monkeypatch, None)
-    off2, n_off2 = _resident_step_jaxpr(monkeypatch, "0")
-    host, n_host = _resident_step_jaxpr(monkeypatch, "host")
-    on, n_on = _resident_step_jaxpr(monkeypatch, "1")
+    program_audit.load_contracts()
+    art = program_audit.variant_artifact(
+        "nqueens", labels=["off", "obs0", "obs-host", "obs1"]
+    )
     # Disabled (and host-only) builds are byte-identical: counters are
-    # compiled OUT, not branched — the 7-leaf carry of the original step.
-    assert off1 == off2 == host
-    assert n_off1 == n_off2 == n_host == 7
-    # Enabled build carries exactly one extra leaf (the counter block).
-    assert n_on == 8
-    assert on != off1
+    # compiled OUT, not branched — the 7-leaf carry of the original step;
+    # the enabled build carries exactly one extra leaf (the counter block).
+    assert contracts.run_one("obs-off-identity", art) == []
+    assert contracts.run_one("obs-counter-block", art) == []
 
 
-def test_program_cache_keys_on_obs(monkeypatch):
-    import jax
+def test_program_cache_keys_on_obs():
+    from tpu_tree_search.analysis import contracts, program_audit
 
-    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
-
-    prob = NQueensProblem(N=8)
-    capacity, M = resolve_capacity(prob, 64, None)
-    monkeypatch.delenv("TTS_OBS", raising=False)
-    p_off = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
-    monkeypatch.setenv("TTS_OBS", "1")
-    p_on = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
-    assert p_off is not p_on and p_on.obs and not p_off.obs
-    monkeypatch.delenv("TTS_OBS", raising=False)
-    assert _make_program(prob, 5, M, 4, capacity, jax.devices()[0]) is p_off
+    program_audit.load_contracts()
+    art = program_audit.cache_key_artifact("nqueens")
+    a, b = art.distinct["TTS_OBS"]
+    assert b.obs and not a.obs
+    assert contracts.run_one("program-cache-key-sound", art) == []
 
 
 # -- trace file schema -----------------------------------------------------

@@ -13,7 +13,6 @@ block size must key the program caches.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -136,57 +135,22 @@ def test_pallas_kernels_pair_group_parity_at_P190(pg):
     assert np.array_equal(self_oracle, self_got)
 
 
-def _count_loop_ops(closed_jaxpr) -> int:
-    """Serial device loops in a jaxpr: fori_loop lowers to `scan` when the
-    trip count is static and `while` otherwise — count both, recursively
-    through pjit/cond/scan sub-jaxprs."""
-    def subjaxprs(v):
-        if hasattr(v, "jaxpr"):  # ClosedJaxpr
-            yield v.jaxpr
-        elif isinstance(v, (list, tuple)):
-            for x in v:
-                if hasattr(x, "jaxpr"):
-                    yield x.jaxpr
-
-    def walk(jaxpr) -> int:
-        total = 0
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name in ("while", "scan"):
-                total += 1
-            for v in eqn.params.values():
-                for sub in subjaxprs(v):
-                    total += walk(sub)
-        return total
-    return walk(closed_jaxpr.jaxpr)
-
-
 def test_blocked_jaxpr_has_no_per_pair_loop():
-    """The pinned structural claim: with blocking on, the compiled lb2
-    child/self evaluators contain NO fori_loop whose trip count scales
-    with P — the only while op left is `_parent_state`'s O(n) prefix scan.
-    The serial build (Pb=1) keeps its pair loop (2 while ops), so the
-    count isn't trivially zero-by-construction."""
-    prob = PFSPProblem(inst=21, lb="lb2", ub=1)
-    t = _tables(prob)
-    n = prob.jobs
-    args = (jnp.zeros((8, n), jnp.int32), jnp.zeros((8,), jnp.int32),
-            t.ptm_t, t.min_heads, t.min_tails, t.pairs, t.lags,
-            t.johnson_schedules)
+    """The pinned structural claim — routed through the contract registry
+    (`lb2-pairblock-loop-free`, declared in ops/pfsp_device.py, ISSUE 8):
+    with blocking on, the compiled lb2 child/self evaluators contain NO
+    fori_loop whose trip count scales with P — the only loop left is
+    `_parent_state`'s O(n) prefix scan.  The serial build (Pb=1) keeps its
+    pair loop, so the count isn't trivially zero-by-construction; the
+    audit traces both at the ta021 shape (P=190, where auto genuinely
+    blocks)."""
+    from tpu_tree_search.analysis import program_audit
 
-    def child(pb):
-        return jax.make_jaxpr(
-            lambda *a: P._lb2_chunk(*a, pairblock=pb))(*args)
-
-    def self_(pb):
-        return jax.make_jaxpr(
-            lambda *a: P._lb2_self_chunk(*a, pairblock=pb))(*args)
-
-    pb_auto = P.lb2_pairblock(t.pairs.shape[0], n)
-    assert pb_auto > 1  # default policy actually blocks at ta021
-    assert _count_loop_ops(child(1)) == 2  # n-scan + serial pair loop
-    assert _count_loop_ops(child(pb_auto)) == 1  # n-scan only
-    assert _count_loop_ops(self_(1)) == 2
-    assert _count_loop_ops(self_(pb_auto)) == 1
+    program_audit.load_contracts()
+    # Serial (Pb=1, non-vacuity arm) + the auto resolution; the explicit
+    # mid-size block rides the full-matrix `tts check` CI job.
+    findings = program_audit.audit_lb2_eval(pairblocks=(1, None))
+    assert findings == [], [f.render() for f in findings]
 
 
 def test_pairblock_keys_routing_token_and_rebuilds_program(monkeypatch):

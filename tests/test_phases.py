@@ -19,58 +19,37 @@ def _cycle_sum(pp: dict) -> int:
     return sum(pp[s] for s in phases.CYCLE_SLOTS)
 
 
-# -- zero-cost disabled path ----------------------------------------------
+# -- zero-cost disabled path (routed through the contract registry) --------
+# The byte-identity, block-leaf, and cache-key claims are Contracts
+# (obs/phases.py, engine/resident.py) checked over the whole knob matrix
+# by `tts check`; these tests pin the same registry entries on the
+# historical cell.
 
 
-def _resident_step_jaxpr(monkeypatch, phaseprof: str | None,
-                         obs: str | None = None) -> tuple[str, int]:
-    import jax
+def test_disabled_jaxpr_identical_and_clock_free():
+    from tpu_tree_search.analysis import contracts, program_audit
 
-    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
-
-    for knob, val in (("TTS_PHASEPROF", phaseprof), ("TTS_OBS", obs)):
-        if val is None:
-            monkeypatch.delenv(knob, raising=False)
-        else:
-            monkeypatch.setenv(knob, val)
-    prob = NQueensProblem(N=8)  # fresh instance: no cached programs
-    capacity, M = resolve_capacity(prob, 64, None)
-    prog = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
-    state = prog.init_state({}, 0)
-    jaxpr = jax.make_jaxpr(prog._step)(*state)
-    return str(jaxpr), len(jaxpr.jaxpr.outvars)
-
-
-def test_disabled_jaxpr_identical_and_clock_free(monkeypatch):
-    off1, n_off1 = _resident_step_jaxpr(monkeypatch, None)
-    off2, n_off2 = _resident_step_jaxpr(monkeypatch, "0")
-    on, n_on = _resident_step_jaxpr(monkeypatch, "1")
-    both, n_both = _resident_step_jaxpr(monkeypatch, "1", obs="1")
+    program_audit.load_contracts()
+    art = program_audit.variant_artifact(
+        "nqueens", labels=["off", "phase0", "phase1", "phase1-obs1"]
+    )
     # Off builds are byte-identical: the phase block is compiled out, not
     # branched — exactly the counter-block contract (tests/test_obs.py).
-    assert off1 == off2
-    assert n_off1 == n_off2 == 7
-    # Armed build carries exactly one extra output leaf (the phase block);
-    # with device counters too, one more (order: ..., ctr, ph).
-    assert n_on == 8
-    assert n_both == 9
-    assert on != off1
+    # The armed build carries exactly one extra output leaf (the phase
+    # block); with device counters too, one more (order: ..., ctr, ph).
+    assert contracts.run_one("phaseprof-off-identity", art) == []
+    assert contracts.run_one("phaseprof-block-leaf", art) == []
+    assert art.outvars("off") == 7
 
 
-def test_program_cache_keys_on_phaseprof(monkeypatch):
-    import jax
+def test_program_cache_keys_on_phaseprof():
+    from tpu_tree_search.analysis import contracts, program_audit
 
-    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
-
-    prob = NQueensProblem(N=8)
-    capacity, M = resolve_capacity(prob, 64, None)
-    monkeypatch.delenv("TTS_PHASEPROF", raising=False)
-    p_off = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
-    monkeypatch.setenv("TTS_PHASEPROF", "1")
-    p_on = _make_program(prob, 5, M, 4, capacity, jax.devices()[0])
-    assert p_off is not p_on and p_on.phaseprof and not p_off.phaseprof
-    monkeypatch.delenv("TTS_PHASEPROF", raising=False)
-    assert _make_program(prob, 5, M, 4, capacity, jax.devices()[0]) is p_off
+    program_audit.load_contracts()
+    art = program_audit.cache_key_artifact("nqueens")
+    a, b = art.distinct["TTS_PHASEPROF"]
+    assert b.phaseprof and not a.phaseprof
+    assert contracts.run_one("program-cache-key-sound", art) == []
 
 
 # -- armed semantics: bit-identity + the telescoping identity --------------

@@ -115,6 +115,27 @@ def test_dispatch_queue_mechanics():
     assert len(q) == 0
 
 
+# -- program-level inertness (routed through the contract registry) ---------
+
+
+def test_pipeline_and_guard_knobs_are_program_inert():
+    """TTS_PIPELINE and TTS_GUARD are host-side knobs: flipping them must
+    neither change the compiled step (byte-identity contracts declared in
+    engine/pipeline.py / analysis/guard.py) nor fork the program cache
+    (engine/resident.py's cache-key contract) — `tts check` verifies the
+    same entries across the whole knob matrix."""
+    from tpu_tree_search.analysis import contracts, program_audit
+
+    program_audit.load_contracts()
+    art = program_audit.variant_artifact(
+        "nqueens", labels=["off", "pipe0", "pipe2", "guard1"]
+    )
+    assert contracts.run_one("pipeline-knob-inert", art) == []
+    assert contracts.run_one("guard-knob-inert", art) == []
+    keys = program_audit.cache_key_artifact("nqueens")
+    assert contracts.run_one("program-cache-key-sound", keys) == []
+
+
 # -- the no-op-dispatch invariant (what makes speculation exact) ------------
 
 

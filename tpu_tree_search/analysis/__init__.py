@@ -26,6 +26,7 @@ from .guard import GuardViolation, SteadyStateGuard, guard_enabled
 
 # Rule modules register themselves into RULES at import time.
 from . import jax_rules as _jax_rules  # noqa: E402,F401  (registration)
+from . import lockorder as _lockorder  # noqa: E402,F401  (registration)
 from . import locks as _locks  # noqa: E402,F401  (registration)
 
 __all__ = [
@@ -50,17 +51,26 @@ def lint(paths, baseline: dict[str, int] | None = None,
     """
     modules, parse_errors = parse_modules(paths)
     findings = run_rules(modules, only=rules)
-    active, waived = apply_waivers(modules, findings)
+    active, waived = apply_waivers(
+        modules, findings,
+        selected_rules=set(rules) if rules is not None else None,
+    )
     active = parse_errors + active
     new, old = ratchet(active, baseline or {})
     return {"new": new, "baselined": old, "waived": waived}
 
 
 def _default_paths() -> list[str]:
-    # Repo checkout first; fall back to the installed package so
-    # `tts lint` works from anywhere.
+    # Repo checkout first (package + the bench/scripts harnesses — ISSUE 8
+    # widened the default scan scope to everything the CI gate covers);
+    # fall back to the installed package so `tts lint` works from anywhere.
     if os.path.isdir("tpu_tree_search"):
-        return ["tpu_tree_search"]
+        paths = ["tpu_tree_search"]
+        if os.path.isfile("bench.py"):
+            paths.append("bench.py")
+        if os.path.isdir("scripts"):
+            paths.append("scripts")
+        return paths
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
 

@@ -58,9 +58,20 @@ def waivers_for(module: Module) -> tuple[dict[int, set[str]], list[Finding]]:
 
 
 def apply_waivers(
-    modules: list[Module], findings: list[Finding]
+    modules: list[Module], findings: list[Finding],
+    selected_rules: set[str] | None = None,
 ) -> tuple[list[Finding], list[Finding]]:
-    """Split findings into (active, waived)."""
+    """Split findings into (active, waived).
+
+    Stale-waiver detection (ISSUE 8): a waiver whose rule *did run* but no
+    longer fires on its line is dead weight that silently disarms the rule
+    for any future edit of that line — it becomes a ``waiver-stale``
+    finding.  ``selected_rules`` is the set of rules this run executed
+    (``None`` = all): a waiver for a rule that was not run cannot be judged
+    and is left alone, and a waiver naming a rule that does not exist is
+    always stale."""
+    from .core import RULES
+
     by_path: dict[str, dict[int, set[str]]] = {}
     extra: list[Finding] = []
     for mod in modules:
@@ -69,12 +80,31 @@ def apply_waivers(
         extra.extend(bad)
     active: list[Finding] = list(extra)
     waived: list[Finding] = []
+    used: set[tuple[str, int, str]] = set()
     for f in findings:
         rules = by_path.get(f.path, {}).get(f.line, set())
         if f.rule in rules:
             waived.append(f)
+            used.add((f.path, f.line, f.rule))
         else:
             active.append(f)
+    ran = set(RULES) if selected_rules is None else set(selected_rules)
+    for mod in modules:
+        for line, rules in sorted(by_path.get(mod.path, {}).items()):
+            for r in sorted(rules):
+                if r in RULES and r not in ran:
+                    continue  # not judged this run
+                if (mod.path, line, r) in used:
+                    continue
+                reason = (
+                    "names unknown rule" if r not in RULES
+                    else "its rule no longer fires on this line"
+                )
+                active.append(Finding(
+                    "waiver-stale", mod.path, line, 0,
+                    f"stale waiver for '{r}': {reason} — remove it (a dead "
+                    "waiver silently disarms the rule for future edits)",
+                ))
     return active, waived
 
 

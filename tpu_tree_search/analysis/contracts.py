@@ -1,0 +1,270 @@
+"""Compiled-program contract registry (``tts check``).
+
+The repo's performance claims are claims about *compiled-program
+structure*: "the dense survivor path lowers free of sort/scatter", "one
+child-value gather per cycle in every mode", "telemetry off is
+byte-identical, compiled out not branched", "the pipeline knob never leaks
+into the device program".  Until ISSUE 8 each claim was pinned by a one-off
+jaxpr assertion in the test file that introduced it — each guarding only
+the single knob combination its author traced.  This module is the single
+registry those pins migrated into: a :class:`Contract` is a named,
+documented claim plus a check over a traced program artifact, **declared
+next to the code it pins** (``ops/compaction.py`` declares the dense
+contracts, ``engine/resident.py`` the fused-push and donation contracts,
+``obs/counters.py``/``obs/phases.py`` the off-identity contracts, …) and
+evaluated by ``analysis/program_audit.py`` over every cell of the knob
+matrix — tracing only, no execution, CPU is enough.
+
+Registration happens at import time of the declaring module;
+``program_audit.load_contracts()`` imports them all.  The registry is
+append-only within a process: redefining a name raises (two modules
+claiming one contract is a bug, except under module reload, where the
+declaring module re-registering its own contract is idempotent).
+
+Artifact families (what a check receives):
+
+* ``resident-step`` — a :class:`StepArtifact` of one matrix cell's
+  resident program: the built program object, its closed jaxpr, the
+  recursive primitive list, and the lowered StableHLO text (lazy).
+* ``compact-ids``   — jaxpr of the bare ``ops.compaction.compact_ids``
+  rank inversion for one mode.
+* ``lb2-eval``      — jaxprs of the lb2 child/self chunk evaluators at one
+  pair-block size.
+* ``variants``      — a :class:`VariantArtifact`: jaxpr texts of one base
+  configuration traced under several knob settings, for the byte-identity
+  and knob-inertness contracts.
+* ``cache-key``     — a :class:`CacheKeyArtifact`: the observed program
+  cache behavior under knob flips on one problem instance.
+* ``lock-graph``    — the static lock-acquisition graph
+  (``analysis/lockorder.py``).
+
+The helpers below (``prim_eqns``, ``prim_counts``, ``loop_op_count``,
+``child_value_gathers``) are the one implementation of jaxpr-walking the
+five migrated test files each used to re-implement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+__all__ = [
+    "CONTRACTS",
+    "Contract",
+    "CacheKeyArtifact",
+    "StepArtifact",
+    "VariantArtifact",
+    "child_value_gathers",
+    "contract",
+    "loop_op_count",
+    "prim_counts",
+    "prim_eqns",
+    "subjaxprs",
+]
+
+
+# -- jaxpr walking (shared by contracts, tests, and the fingerprints) ------
+
+
+def subjaxprs(value):
+    """Sub-jaxprs reachable from one eqn param value (while/cond/scan/pjit
+    bodies come through params as Jaxpr/ClosedJaxpr or lists of them)."""
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    if isinstance(value, Jaxpr):
+        return [value]
+    if isinstance(value, ClosedJaxpr):
+        return [value.jaxpr]
+    if isinstance(value, (list, tuple)):
+        return [j for v in value for j in subjaxprs(v)]
+    return []
+
+
+def prim_eqns(jaxpr, out=None):
+    """Every ``(primitive_name, eqn)`` in a jaxpr, recursing into
+    sub-jaxprs.  Accepts an open or closed jaxpr."""
+    if hasattr(jaxpr, "jaxpr"):  # ClosedJaxpr
+        jaxpr = jaxpr.jaxpr
+    if out is None:
+        out = []
+    for eqn in jaxpr.eqns:
+        out.append((eqn.primitive.name, eqn))
+        for v in eqn.params.values():
+            for sub in subjaxprs(v):
+                prim_eqns(sub, out)
+    return out
+
+
+def prim_counts(jaxpr) -> dict[str, int]:
+    """Recursive primitive histogram — the op fingerprint unit."""
+    counts: dict[str, int] = {}
+    for name, _ in prim_eqns(jaxpr):
+        counts[name] = counts.get(name, 0) + 1
+    return dict(sorted(counts.items()))
+
+
+def loop_op_count(jaxpr) -> int:
+    """Serial device loops: ``fori_loop`` lowers to ``scan`` when the trip
+    count is static and ``while`` otherwise — count both, recursively."""
+    return sum(1 for name, _ in prim_eqns(jaxpr) if name in ("while", "scan"))
+
+
+def child_value_gathers(prims, rows: int, lanes: int, vals_dtype) -> list:
+    """The gather eqns big enough to be moving child values: any output of
+    >= ``rows * lanes`` elements in the pool value dtype.  (Mask gathers —
+    bool/int32 keep/lane planes — move no node data and are exempt by the
+    fused-push contract's definition.)"""
+    out = []
+    for name, eqn in prims:
+        if name != "gather":
+            continue
+        if any(
+            v.aval.size >= rows * lanes and v.aval.dtype == vals_dtype
+            for v in eqn.outvars
+        ):
+            out.append(eqn)
+    return out
+
+
+# -- the registry ----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """One named compiled-program claim.
+
+    ``check(artifact, cell)`` returns a list of violation messages (empty =
+    the claim holds for that cell).  ``applies(cell)`` filters which matrix
+    cells the contract runs on; None = every cell carrying its artifact
+    family.  ``declared_in`` records the module that owns the claim (the
+    catalogue in docs/ANALYSIS.md is generated from these fields).
+    """
+
+    name: str
+    claim: str
+    artifact: str
+    check: Callable
+    applies: Callable | None = None
+    declared_in: str = ""
+
+    def run(self, artifact, cell) -> list[str]:
+        if self.applies is not None and not self.applies(cell):
+            return []
+        return list(self.check(artifact, cell))
+
+
+#: name -> Contract.  Populated at import time by the declaring modules
+#: (``program_audit.load_contracts()`` imports them all).
+CONTRACTS: dict[str, Contract] = {}
+
+
+def contract(name: str, claim: str, artifact: str,
+             applies: Callable | None = None):
+    """Decorator: register the decorated check function as a contract.
+
+    Declared next to the code it pins — the decorated function stays
+    importable and individually callable (the migrated tests call it
+    through :func:`run_one`)."""
+
+    def deco(fn):
+        mod = getattr(fn, "__module__", "") or ""
+        prev = CONTRACTS.get(name)
+        if prev is not None and prev.declared_in != mod:
+            raise ValueError(
+                f"contract {name!r} already declared in {prev.declared_in}"
+            )
+        CONTRACTS[name] = Contract(
+            name=name, claim=claim, artifact=artifact, check=fn,
+            applies=applies, declared_in=mod,
+        )
+        return fn
+
+    return deco
+
+
+def get(name: str) -> Contract:
+    if name not in CONTRACTS:
+        raise KeyError(
+            f"unknown contract {name!r} (loaded: {sorted(CONTRACTS)}) — "
+            "did program_audit.load_contracts() run?"
+        )
+    return CONTRACTS[name]
+
+
+def run_one(name: str, artifact, cell=None) -> list[str]:
+    """Evaluate one contract directly (the migrated tests' entry point:
+    a test builds its artifact and asserts ``run_one(...) == []``, so the
+    registry stays the single owner of the check logic)."""
+    c = get(name)
+    return list(c.check(artifact, cell))
+
+
+# -- artifacts -------------------------------------------------------------
+
+
+class StepArtifact:
+    """One matrix cell's resident-step program, traced but never executed.
+
+    ``prog`` is the built ``_ResidentProgram`` (carries the resolved
+    compaction mode, S budget, obs/phaseprof flags); ``jaxpr`` its closed
+    jaxpr; ``prims`` the recursive primitive list.  ``lowered_text`` lowers
+    to StableHLO on first use (donation/aliasing is a lowering-level fact —
+    it does not appear in the jaxpr)."""
+
+    def __init__(self, prog, jaxpr, lower_fn=None, eval_counts=None):
+        self.prog = prog
+        self.jaxpr = jaxpr
+        self.prims = prim_eqns(jaxpr)
+        self.prim_names = {n for n, _ in self.prims}
+        #: Primitive histogram of the BARE bound evaluator (traced alone):
+        #: the survivor-path contracts budget against it — the step may
+        #: contain the evaluator's own sort/scatter ops, and nothing more.
+        self.eval_counts: dict[str, int] = eval_counts or {}
+        self._lower_fn = lower_fn
+        self._lowered_text: str | None = None
+
+    @property
+    def text(self) -> str:
+        return str(self.jaxpr)
+
+    @property
+    def counts(self) -> dict[str, int]:
+        return prim_counts(self.jaxpr)
+
+    @property
+    def lowered_text(self) -> str:
+        if self._lowered_text is None:
+            if self._lower_fn is None:
+                raise RuntimeError("artifact built without a lower_fn")
+            self._lowered_text = self._lower_fn()
+        return self._lowered_text
+
+
+@dataclasses.dataclass
+class VariantArtifact:
+    """Jaxpr texts (+ outvar counts) of one base configuration traced under
+    several knob settings: ``variants[label] = (text, n_outvars)``.  The
+    identity/inertness contracts compare labels; which labels exist is part
+    of each contract's own applicability check."""
+
+    variants: dict[str, tuple[str, int]]
+
+    def text(self, label: str) -> str:
+        return self.variants[label][0]
+
+    def outvars(self, label: str) -> int:
+        return self.variants[label][1]
+
+    def has(self, *labels: str) -> bool:
+        return all(lb in self.variants for lb in labels)
+
+
+@dataclasses.dataclass
+class CacheKeyArtifact:
+    """Observed program-cache behavior on ONE problem instance:
+    ``distinct[knob]`` — programs built under a flip of ``knob`` (must be
+    different cache entries); ``shared[knob]`` — programs built under a
+    flip of an inert knob (must be the *same* cache entry)."""
+
+    distinct: dict[str, tuple[object, object]]
+    shared: dict[str, tuple[object, object]]

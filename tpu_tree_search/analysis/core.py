@@ -197,7 +197,7 @@ def run_rules(modules: list[Module],
               only: Iterable[str] | None = None) -> list[Finding]:
     # Import for registration side effects (kept out of module import time
     # so `tpu_tree_search.analysis.guard` stays importable alone).
-    from . import jax_rules, locks  # noqa: F401
+    from . import jax_rules, lockorder, locks  # noqa: F401
 
     project = Project(modules)
     selected = set(only) if only is not None else set(RULES)

@@ -108,3 +108,23 @@ class SteadyStateGuard:
         offload fallback re-uploading a rebuilt pool)."""
         self.steps = 0
         self._warm_cache = None
+
+
+# -- compiled-program contracts (`tts check`, analysis/contracts.py) --------
+
+from .contracts import contract
+
+
+@contract(
+    "guard-knob-inert",
+    claim="TTS_GUARD=1 never changes the compiled program — the guard "
+          "observes dispatches; an instrument that perturbs what it "
+          "measures would make every guarded run unrepresentative",
+    artifact="variants",
+)
+def _contract_guard_inert(art, cell):
+    if not art.has("off", "guard1"):
+        return []
+    if art.text("off") == art.text("guard1"):
+        return []
+    return ["TTS_GUARD leaked into the compiled step"]

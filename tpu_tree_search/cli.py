@@ -211,6 +211,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_lint_args(lint)
 
+    chk = sub.add_parser(
+        "check",
+        help="compiled-program contract audit: trace every knob-matrix "
+        "cell's resident program (no execution, CPU is enough) and "
+        "verify the registered structural contracts + the op-fingerprint "
+        "baseline + the lock-order audit (docs/ANALYSIS.md)",
+    )
+    from .analysis.program_audit import add_check_args
+
+    add_check_args(chk)
+
     rep = sub.add_parser(
         "report",
         help="summarize a --trace file: steal efficiency, idle fraction "
@@ -827,7 +838,7 @@ def main(argv=None) -> int:
                 "`tts profile pfsp --inst 14 --tier device`"
             )
         args = parser.parse_args(rest)
-        if args.problem in ("lint", "report", "watch", "profile"):
+        if args.problem in ("lint", "check", "report", "watch", "profile"):
             parser.error("profile wraps a search run, not another "
                          "subcommand")
         args.phase_profile = True
@@ -836,6 +847,11 @@ def main(argv=None) -> int:
         from .analysis import run_lint_cli
 
         return run_lint_cli(args)
+    if args.problem == "check":
+        # Tracing-only program audit (jax traces, nothing executes).
+        from .analysis.program_audit import run_check_cli
+
+        return run_check_cli(args)
     if args.problem == "report":
         # Pure trace summarization: no jax import, no backend init.
         from .obs.report import report_main

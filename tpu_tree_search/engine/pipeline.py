@@ -266,3 +266,25 @@ class DispatchQueue:
         K resizes, donation downloads, and the capacity-stall fallback."""
         while self._q:
             yield self._q.popleft()
+
+
+# -- compiled-program contracts (`tts check`, analysis/contracts.py) --------
+
+from ..analysis.contracts import contract
+
+
+@contract(
+    "pipeline-knob-inert",
+    claim="TTS_PIPELINE never reaches the compiled program: depth-0 and "
+          "depth-2 builds are byte-identical — speculation is host-side "
+          "queueing only, and its exactness rests on the no-op-dispatch "
+          "invariant of the while-cond, not on a program variant",
+    artifact="variants",
+)
+def _contract_pipeline_inert(art, cell):
+    if not art.has("off", "pipe0", "pipe2"):
+        return []
+    if art.text("off") == art.text("pipe0") == art.text("pipe2"):
+        return []
+    return ["TTS_PIPELINE leaked into the compiled step (depth-dependent "
+            "program structure breaks the exact-speculation argument)"]

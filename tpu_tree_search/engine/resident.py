@@ -1024,3 +1024,95 @@ def resident_search(
         obs=obs_result(),
         phase_profile=ph_total,
     )
+
+
+# -- compiled-program contracts (`tts check`, analysis/contracts.py) --------
+# The fused-push / donation / steady-state-purity claims of this engine,
+# declared here and verified for EVERY knob-matrix cell by
+# analysis/program_audit.py (previously scattered one-cell jaxpr pins in
+# tests/test_compaction.py and runtime-only guard assertions).
+
+from ..analysis.contracts import child_value_gathers, contract  # noqa: E402
+
+
+@contract(
+    "fused-push-single-gather",
+    claim="in EVERY survivor-path mode the compiled step contains at most "
+          "ONE gather big enough to be moving child values (>= S rows of "
+          "n lanes in the pool value dtype) — the single augmented "
+          "(row, aux) gather of the fused prune+push; mask gathers move "
+          "no node data and are exempt",
+    artifact="resident-step",
+)
+def _contract_single_gather(art, cell):
+    prog = art.prog
+    n = prog.problem.child_slots
+    vals_dt = np.dtype(prog.pool_fields[0][1])
+    big = child_value_gathers(art.prims, prog.S, n, vals_dt)
+    if len(big) <= 1:
+        return []
+    return [
+        f"{len(big)} child-value-sized gathers in the step (budget is 1): "
+        + "; ".join(str(e).splitlines()[0][:120] for e in big)
+    ]
+
+
+@contract(
+    "pool-donation",
+    claim="the resident step donates its pool buffers (input/output "
+          "aliasing present in the lowered program) — pipelined dispatch "
+          "chains the carry device-side and correctness of the memory "
+          "budget depends on the donation never silently disappearing",
+    artifact="resident-step",
+)
+def _contract_pool_donation(art, cell):
+    txt = art.lowered_text
+    if "tf.aliasing_output" in txt or "jax.buffer_donor" in txt:
+        return []
+    return ["no input-output aliasing in the lowered step (donation lost)"]
+
+
+@contract(
+    "step-callback-armed-only",
+    claim="the steady-state step program contains no host callbacks and no "
+          "infeed/outfeed — EXCEPT the phase-profiler variant, whose "
+          "pure_callback clock reads are the armed instrument and must be "
+          "present there (and only there)",
+    artifact="resident-step",
+)
+def _contract_callbacks(art, cell):
+    cbs = sorted(
+        n for n in art.prim_names
+        if "callback" in n or n in ("infeed", "outfeed")
+    )
+    armed = cell is not None and getattr(cell, "phaseprof", "0") == "1"
+    if armed:
+        if any("callback" in n for n in cbs):
+            return []
+        return ["armed phase-profiler variant lowered without its clock "
+                "callback (the instrument is silently gone)"]
+    if cbs:
+        return [f"host-callback ops in an unarmed steady-state step: {cbs}"]
+    return []
+
+
+@contract(
+    "program-cache-key-sound",
+    claim="knobs baked into the compiled program (TTS_COMPACT, TTS_OBS, "
+          "TTS_PHASEPROF, TTS_LB2_PAIRBLOCK) key the resident program "
+          "cache — a flip rebuilds, never reuses stale structure; "
+          "host-only knobs (TTS_PIPELINE, TTS_GUARD) hit the same cached "
+          "program — they must not fork compilations",
+    artifact="cache-key",
+)
+def _contract_cache_key(art, cell):
+    out = []
+    for knob, (a, b) in art.distinct.items():
+        if a is b:
+            out.append(f"{knob} flip reused the same cached program "
+                       "(stale structure would run)")
+    for knob, (a, b) in art.shared.items():
+        if a is not b:
+            out.append(f"{knob} flip rebuilt the program (a host-only knob "
+                       "leaks into the cache key and forks compilations)")
+    return out

@@ -128,3 +128,54 @@ def as_args(block) -> dict:
     metrics lines (multi-shard blocks sum the additive slots and max the
     high-water marks, like ``merge_host``)."""
     return merge_host(None, block)
+
+
+# -- compiled-program contracts (`tts check`, analysis/contracts.py) --------
+# The zero-cost-disabled-path claim of the module docstring, as checked
+# contracts (previously a one-cell jaxpr pin in tests/test_obs.py).
+
+from ..analysis.contracts import contract
+
+
+@contract(
+    "obs-off-identity",
+    claim="TTS_OBS unset, =0, and =host build byte-identical resident step "
+          "jaxprs with the original 7-leaf carry — counters are compiled "
+          "OUT when off, never branched (host mode touches no device "
+          "program)",
+    artifact="variants",
+)
+def _contract_obs_off_identity(art, cell):
+    if not art.has("off", "obs0", "obs-host"):
+        return []
+    out = []
+    if not (art.text("off") == art.text("obs0") == art.text("obs-host")):
+        out.append("disabled/host obs builds are not byte-identical to the "
+                   "unset build (a counter leaked into the off path)")
+    for lb in ("off", "obs0", "obs-host"):
+        if art.outvars(lb) != 7:
+            out.append(f"{lb} build carries {art.outvars(lb)} output leaves "
+                       "(the counter-free step carries 7)")
+    return out
+
+
+@contract(
+    "obs-counter-block",
+    claim="TTS_OBS=1 adds exactly ONE output leaf (the counter block) and "
+          "genuinely changes the program — the armed variant is a "
+          "distinct compilation, not a branch",
+    artifact="variants",
+)
+def _contract_obs_counter_block(art, cell):
+    if not art.has("off", "obs1"):
+        return []
+    out = []
+    if art.outvars("obs1") != art.outvars("off") + 1:
+        out.append(
+            f"armed obs build carries {art.outvars('obs1')} output leaves "
+            f"(expected {art.outvars('off') + 1}: base + the counter block)"
+        )
+    if art.text("obs1") == art.text("off"):
+        out.append("armed obs build is byte-identical to the off build "
+                   "(the counter block is silently gone)")
+    return out

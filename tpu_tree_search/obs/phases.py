@@ -294,3 +294,55 @@ class XlaTraceWindow:
                 if XlaTraceWindow._active is self:
                     XlaTraceWindow._active = None
             self._owner = False
+
+
+# -- compiled-program contracts (`tts check`, analysis/contracts.py) --------
+
+from ..analysis.contracts import contract
+
+
+@contract(
+    "phaseprof-off-identity",
+    claim="TTS_PHASEPROF unset and =0 build byte-identical resident step "
+          "jaxprs — the phase-clock block is compiled out when off, never "
+          "branched (same contract as the obs counter block)",
+    artifact="variants",
+)
+def _contract_phaseprof_off_identity(art, cell):
+    if not art.has("off", "phase0"):
+        return []
+    out = []
+    if art.text("off") != art.text("phase0"):
+        out.append("TTS_PHASEPROF=0 build differs from the unset build "
+                   "(clock reads leaked into the off path)")
+    if art.outvars("phase0") != art.outvars("off"):
+        out.append("TTS_PHASEPROF=0 build changed the carry width")
+    return out
+
+
+@contract(
+    "phaseprof-block-leaf",
+    claim="the armed phase profiler adds exactly ONE output leaf (the "
+          "phase-clock block), two when device counters ride along "
+          "(order: ..., ctr, ph) — and genuinely changes the program",
+    artifact="variants",
+)
+def _contract_phaseprof_block(art, cell):
+    if not art.has("off", "phase1", "phase1-obs1"):
+        return []
+    out = []
+    base = art.outvars("off")
+    if art.outvars("phase1") != base + 1:
+        out.append(
+            f"armed phase build carries {art.outvars('phase1')} output "
+            f"leaves (expected {base + 1})"
+        )
+    if art.outvars("phase1-obs1") != base + 2:
+        out.append(
+            f"armed phase+obs build carries {art.outvars('phase1-obs1')} "
+            f"output leaves (expected {base + 2})"
+        )
+    if art.text("phase1") == art.text("off"):
+        out.append("armed phase build is byte-identical to off (the clock "
+                   "block is silently gone)")
+    return out

@@ -230,3 +230,120 @@ def compact_ids(keep, S: int, mode: str):
         .set(flat_idx, mode="drop", unique_indices=True)
     )
     return ids, tree_inc
+
+
+# -- compiled-program contracts (`tts check`, analysis/contracts.py) --------
+# The survivor-path performance claims, declared next to the code that
+# makes them and verified over the whole knob matrix by
+# analysis/program_audit.py (these used to live as one-off jaxpr pins in
+# tests/test_compaction.py, each guarding a single knob combination).
+
+from ..analysis.contracts import contract, prim_eqns  # noqa: E402
+
+
+@contract(
+    "dense-step-no-sort-scatter",
+    claim="a resident step whose resolved survivor path is `dense` adds "
+          "ZERO sort and ZERO scatter ops beyond the bound evaluator's "
+          "own — compaction, fused push, and the overflow fallback branch "
+          "are all shift/select-structured (searchsorted has no primitive "
+          "of its own; this plus the gather ban in dense-ids-shift-only "
+          "covers every lowering it could take).  The budget is the BARE "
+          "evaluator's histogram (lb2's one-hot free-flag scatter is the "
+          "evaluator's business), plus the armed phase profiler's "
+          "accumulation into its own (NSLOTS+1,) clock block — anything "
+          "else is survivor-path structure and is banned",
+    artifact="resident-step",
+)
+def _contract_dense_step(art, cell):
+    if art.prog.compact != "dense":
+        return []
+    from ..obs.phases import NSLOTS as _PH_NSLOTS
+
+    out = []
+    allowed = {
+        n: c for n, c in art.eval_counts.items()
+        if n == "sort" or n.startswith("scatter")
+    }
+    seen: dict[str, int] = {}
+    armed = cell is not None and getattr(cell, "phaseprof", "0") == "1"
+    for name, eqn in art.prims:
+        if name != "sort" and not name.startswith("scatter"):
+            continue
+        sizes = [int(v.aval.size) for v in eqn.outvars]
+        if armed and all(s <= _PH_NSLOTS + 1 for s in sizes):
+            continue  # the sanctioned phase-clock block accumulation
+        seen[name] = seen.get(name, 0) + 1
+    for name, cnt in sorted(seen.items()):
+        if cnt > allowed.get(name, 0):
+            out.append(
+                f"dense step contains {cnt}x {name} but the bare evaluator "
+                f"accounts for {allowed.get(name, 0)} — the survivor path "
+                "re-introduced a banned op"
+            )
+    return out
+
+
+@contract(
+    "dense-ids-shift-only",
+    claim="the dense rank inversion (`compact_ids` mode='dense') is pure "
+          "shifts + selects: no sort, no scatter, and not even a gather "
+          "(the fused write performs the cycle's single gather)",
+    artifact="compact-ids",
+)
+def _contract_dense_ids(art, cell):
+    if art["mode"] != "dense":
+        return []
+    names = {n for n, _ in prim_eqns(art["jaxpr"])}
+    bad = sorted(
+        n for n in names
+        if n in ("sort", "gather") or n.startswith("scatter")
+    )
+    return [f"dense compact_ids contains banned ops {bad}"] if bad else []
+
+
+@contract(
+    "scatter-ids-unique",
+    claim="the scatter rank inversion's destination scatter is genuinely "
+          "unique-indexed (XLA owes it no conflict resolution — the mode's "
+          "whole cost model rests on that)",
+    artifact="compact-ids",
+)
+def _contract_scatter_ids(art, cell):
+    if art["mode"] != "scatter":
+        return []
+    scatters = [
+        (n, e) for n, e in prim_eqns(art["jaxpr"]) if n.startswith("scatter")
+    ]
+    if not scatters:
+        return ["scatter mode lowered without any scatter op"]
+    bad = [
+        n for n, e in scatters if not e.params.get("unique_indices", False)
+    ]
+    return (
+        [f"non-unique-indexed scatter(s) in scatter compact_ids: {bad}"]
+        if bad else []
+    )
+
+
+@contract(
+    "compact-auto-identity",
+    claim="TTS_COMPACT=auto bakes in a byte-identical program to the "
+          "explicitly spelled mode it resolves to — the policy layer adds "
+          "zero behavior of its own",
+    artifact="variants",
+)
+def _contract_auto_identity(art, cell):
+    explicit = [
+        lb for lb in art.variants
+        if lb.startswith("compact-") and lb != "compact-auto"
+    ]
+    if "compact-auto" not in art.variants or not explicit:
+        return []  # variant set traced without the compact labels
+    out = []
+    for lb in explicit:
+        if art.text("compact-auto") != art.text(lb):
+            out.append(
+                f"auto-resolved program differs from explicit {lb[8:]!r}"
+            )
+    return out

@@ -935,3 +935,35 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):
     else:
         raise ValueError(f"Unsupported lower bound: {lb!r}")
     return evaluate
+
+
+# -- compiled-program contracts (`tts check`, analysis/contracts.py) --------
+
+from ..analysis.contracts import contract, loop_op_count  # noqa: E402
+
+
+@contract(
+    "lb2-pairblock-loop-free",
+    claim="with pair-blocking on (Pb > 1) the compiled lb2 child/self "
+          "evaluators contain NO loop whose trip count scales with P — "
+          "only `_parent_state`'s O(n) prefix scan survives (1 loop op); "
+          "the serial build (Pb=1) keeps its per-pair fori_loop (2 loop "
+          "ops), so the pin is never trivially zero-by-construction",
+    artifact="lb2-eval",
+)
+def _contract_pairblock_loop_free(art, cell):
+    expect = 2 if art["pairblock"] == 1 else 1
+    out = []
+    for kind in ("child", "self"):
+        got = loop_op_count(art[kind])
+        if got != expect:
+            out.append(
+                f"lb2 {kind} evaluator at Pb={art['pairblock']}: {got} "
+                f"serial loop ops (expected {expect})"
+            )
+    if art.get("auto") and art["pairblock"] <= 1:
+        out.append(
+            "auto pair-block policy resolved to the serial loop at a "
+            "published blocked shape"
+        )
+    return out

@@ -332,7 +332,9 @@ class _HostComm:
         at M nodes so a huge pool never ships an unbounded payload over DCN
         (the reference steals perc-of-pool uncapped, `Pool_ext.c:138-151`;
         the mesh tier here caps donations — same policy)."""
-        # tts-lint: waive guarded-by -- advisory racy size read for victim selection; the pop below re-checks size under try_lock
+        # (No waiver needed: guarded-by does not descend into lambda
+        # bodies, so the advisory racy read in the key fn is out of its
+        # scope — the pop below re-checks size under try_lock anyway.)
         victim = max(pools, key=lambda p: p.size)
         # tts-lint: waive guarded-by -- advisory racy size read; pop_front_bulk_half re-checks the 2m threshold under the lock
         if victim.size < 2 * self.m:

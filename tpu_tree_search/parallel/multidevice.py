@@ -113,6 +113,14 @@ class PauseGate:
             self.want = False
             self._cond.notify_all()
 
+    def all_left(self) -> bool:
+        """True once every worker has exited (the checkpoint timer's stop
+        test).  Owns its lock — callers must not reach into ``_cond``
+        (keeps the lock-order audit's acquisition sites inside the class,
+        docs/ANALYSIS.md)."""
+        with self._cond:
+            return self.active == 0
+
 
 class CheckpointManager:
     """Snapshot-and-save for the multi/dist tiers: pause workers at chunk
@@ -181,9 +189,8 @@ class CheckpointManager:
     # its communicator round instead, so all hosts cut in lockstep) --------
     def _timer_loop(self) -> None:
         while not self._stop.wait(self.interval_s):
-            with self.gate._cond:
-                if self.gate.active == 0:
-                    return
+            if self.gate.all_left():
+                return
             self.do_checkpoint()
 
     def start_timer(self) -> None:
