@@ -1,189 +1,30 @@
 """Warm the persistent XLA/Mosaic compile cache for the validation matrix.
 
-A Mosaic compile on the real chip can cost minutes (lb1 tile-128 measured
->270s) and tunnel windows are short — so during any green window this script
-compiles every program the bench and the smoke gate need, storing the
-executables in the version-keyed compile cache (`cli.enable_compile_cache`).
-A second session then starts from a hot cache: bench's numbers stop being
-hostage to compile time, and its 300s kernel-probe timeout can't silently
-flip the run to the jnp path.
+Thin shim: the warm matrix and the subprocess loop moved to
+``tpu_tree_search/serve/warmup.py`` (the serve daemon reuses them for its
+AOT pool warm at startup); ``tts warmup`` is the first-class entry point
+and adds per-config compile-cache hit/miss reporting. This script remains
+so existing recipes (`python scripts/warm_cache.py` during a green tunnel
+window) keep working unchanged.
 
-Cache keys include the full program shape, so warming MUST run the exact
-entry points with the exact shapes the consumers use: each config below is
-one ``resident_search(..., max_steps=1)`` — the full while-loop program plus
-its kernels, compiled and executed for a single step. Staged and unstaged
-lb2 are distinct programs; both warm. Each config runs in a subprocess with
-its own timeout (a compile hang must only cost its slot, bench.py's probe
-lesson) and prints wall seconds — re-run to see hits (near-zero seconds).
+Optionally pass a config selection: ``python scripts/warm_cache.py
+ta014-lb1,nqueens-15`` (names from ``tts warmup --configs``; default: the
+whole matrix).
 """
 
 from __future__ import annotations
 
 import os
-import subprocess
 import sys
-import time
 
-_ITEM = r"""
-import os, time, sys
-t0 = time.time()
-import jax
-from tpu_tree_search.cli import enable_compile_cache
-from tpu_tree_search.engine.resident import resident_search
-from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-enable_compile_cache()
-kind = sys.argv[1]
-if kind == "kernel":
-    # Kernel-level warm at the smoke-gate shapes: large-instance resident
-    # programs explore tens of millions of nodes in ONE K=4096 dispatch
-    # (max_steps can't cut inside a dispatch), blowing the slot timeout on
-    # execution the cache doesn't need — the session's reusable artifacts
-    # for these classes are the Mosaic KERNEL compiles.
-    import jax.numpy as jnp
-    from tpu_tree_search.ops import pallas_kernels as PK
-    inst, lb, B = int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
-    prob = PFSPProblem(inst=inst, lb=lb, ub=1)
-    t = prob.device_tables()
-    n = prob.jobs
-    prmu = jnp.tile(jnp.arange(n, dtype=jnp.int32), (B, 1))
-    limit1 = jnp.zeros((B,), dtype=jnp.int32)
-    if lb == "lb1":
-        out = PK.pfsp_lb1_bounds(prmu, limit1, t.ptm_t, t.min_heads,
-                                 t.min_tails, bf16=t.exact_bf16)
-    else:
-        out = PK.pfsp_lb2_bounds(prmu, limit1, t)
-    out.block_until_ready()
-    print(f"WARM_OK shape={tuple(out.shape)} wall={time.time() - t0:.1f}s")
-    sys.exit(0)
-if kind == "nqueens":
-    prob = NQueensProblem(N=int(sys.argv[2]))
-else:
-    prob = PFSPProblem(inst=int(sys.argv[2]), lb=sys.argv[3], ub=1)
-M = int(sys.argv[3] if kind == "nqueens" else sys.argv[5])
-res = resident_search(prob, m=25, M=M, max_steps=1)
-print(f"WARM_OK tree={res.explored_tree} wall={time.time() - t0:.1f}s")
-"""
-
-# (label, argv tail, env overrides) — the bench + smoke-gate matrix, most
-# valuable first so a closing window still banks the flagship programs.
-CONFIGS: list[tuple[str, list[str], dict[str, str]]] = [
-    # M values match the bench's measured defaults (HEADLINE_M / lb2_M —
-    # scripts/headline_tune.py, scripts/lb2_tune.py): warming MUST compile
-    # the exact programs the bench dispatches.
-    ("ta014 lb2 staged M=1024", ["pfsp", "14", "lb2", "-", "1024"],
-     {"TTS_LB2_STAGED": "1"}),
-    ("ta014 lb2 unstaged M=1024", ["pfsp", "14", "lb2", "-", "1024"],
-     {"TTS_LB2_STAGED": "0"}),
-    # Pair-block A/B for the armed lb2 session (docs/HW_VALIDATION.md):
-    # the serial-loop build (TTS_LB2_PAIRBLOCK=1) is a distinct program
-    # from the default blocked one warmed above — bank both so the A/B
-    # costs measurement time only.
-    ("ta014 lb2 staged M=1024 pairblock=1", ["pfsp", "14", "lb2", "-", "1024"],
-     {"TTS_LB2_STAGED": "1", "TTS_LB2_PAIRBLOCK": "1"}),
-    # Published BASELINE config 4 (ta021-ta030 class, 20x20, P=190 —
-    # `pfsp_multigpu_chpl.chpl:312`): never benched on chip; warm both
-    # staged variants at the lb2-tuned chunk size so the first measured
-    # ta021 number pays zero compile seconds.
-    ("ta021 lb2 staged M=1024", ["pfsp", "21", "lb2", "-", "1024"],
-     {"TTS_LB2_STAGED": "1"}),
-    ("ta021 lb2 unstaged M=1024", ["pfsp", "21", "lb2", "-", "1024"],
-     {"TTS_LB2_STAGED": "0"}),
-    ("ta014 lb1 M=1024 jnp", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_PALLAS": "0"}),
-    # TTS_K=auto ladder programs for the headline config (geometric rungs
-    # 1..1024; the default row below covers 4096): the adaptive controller
-    # climbs through every rung from the bottom, and each rung is a
-    # distinct while-loop compile — bank them all so an auto-K session
-    # resizes through cache hits instead of paying ~30s per rung
-    # (engine/pipeline.py AdaptiveK; zero steady-state recompiles).
-    ("ta014 lb1 M=1024 K=1", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_K": "1"}),
-    ("ta014 lb1 M=1024 K=4", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_K": "4"}),
-    ("ta014 lb1 M=1024 K=16", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_K": "16"}),
-    ("ta014 lb1 M=1024 K=64", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_K": "64"}),
-    ("ta014 lb1 M=1024 K=256", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_K": "256"}),
-    ("ta014 lb1 M=1024 K=1024", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_K": "1024"}),
-    # Default knob is TTS_COMPACT=auto now (survivor-path overhaul): the
-    # unpinned rows below warm the AUTO programs (dense at these shapes);
-    # the explicit compact=... variants warm the A/B counterparts.
-    ("ta014 lb1 M=1024", ["pfsp", "14", "lb1", "-", "1024"], {}),
-    ("ta014 lb1_d M=1024", ["pfsp", "14", "lb1_d", "-", "1024"], {}),
-    ("nqueens N=15 M=65536", ["nqueens", "15", "65536"], {}),
-    # Published BASELINE config 2 (N-Queens N=16/17): the bench's bounded
-    # rate rows dispatch these exact programs (max_steps cuts the run, the
-    # compile is shape-identical).
-    ("nqueens N=16 M=65536", ["nqueens", "16", "65536"], {}),
-    ("nqueens N=17 M=65536", ["nqueens", "17", "65536"], {}),
-    # First-ever N-Queens chunk-size sweep (VERDICT r5 #2,
-    # scripts/headline_tune.py --problem nqueens --N ...): bank the sweep
-    # grid's end points so the armed session spends its window measuring,
-    # not compiling (the 65536 rows above cover the middle).
-    ("nqueens N=15 M=8192", ["nqueens", "15", "8192"], {}),
-    ("nqueens N=15 M=262144", ["nqueens", "15", "262144"], {}),
-    ("nqueens N=16 M=262144", ["nqueens", "16", "262144"], {}),
-    ("nqueens N=17 M=131072", ["nqueens", "17", "131072"], {}),
-    # Compaction-mode variants (ADVICE r5 + the survivor-path A/B):
-    # bench's on-TPU pick dispatches every TTS_COMPACT mode (the mode is
-    # part of the routing token, so each is a distinct compile) — warm
-    # them too, or a fresh cache makes the pick burn its 600s/300s budget
-    # on compiles and skip modes. `scatter` must be pinned explicitly now
-    # that the default resolves to dense at these shapes.
-    ("ta014 lb1 M=1024 compact=scatter", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_COMPACT": "scatter"}),
-    ("ta014 lb1 M=1024 compact=sort", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_COMPACT": "sort"}),
-    ("ta014 lb1 M=1024 compact=search", ["pfsp", "14", "lb1", "-", "1024"],
-     {"TTS_COMPACT": "search"}),
-    ("ta014 lb2 M=1024 compact=scatter", ["pfsp", "14", "lb2", "-", "1024"],
-     {"TTS_COMPACT": "scatter"}),
-    ("ta014 lb2 M=1024 compact=sort", ["pfsp", "14", "lb2", "-", "1024"],
-     {"TTS_COMPACT": "sort"}),
-    ("ta014 lb2 M=1024 compact=search", ["pfsp", "14", "lb2", "-", "1024"],
-     {"TTS_COMPACT": "search"}),
-    # The N-Queens fused-vs-scatter A/B programs (docs/HW_VALIDATION.md
-    # armed-session rows): default auto resolves dense; scatter is the
-    # round-5 baseline path.
-    ("nqueens N=15 M=65536 compact=scatter", ["nqueens", "15", "65536"],
-     {"TTS_COMPACT": "scatter"}),
-    # Large-instance classes (VERDICT r4 #7): ta031 = 50x10, ta056 = 50x20,
-    # ta111 = 500x20. Kernel-level at the smoke-gate shapes (see _ITEM's
-    # "kernel" note); the set mirrors test_large_instance_kernels_compile_on_tpu.
-    ("ta031 lb1 kernel B=64", ["kernel", "31", "lb1", "64"], {}),
-    ("ta056 lb1 kernel B=32", ["kernel", "56", "lb1", "32"], {}),
-    ("ta056 lb2 kernel B=16", ["kernel", "56", "lb2", "16"], {}),
-    ("ta111 lb1 kernel B=16", ["kernel", "111", "lb1", "16"], {}),
-]
+from tpu_tree_search.serve.warmup import warmup_main  # noqa: E402
 
 
 def main() -> int:
-    timeout_s = float(os.environ.get("TTS_WARM_TIMEOUT", "420"))
-    failures = 0
-    for label, argv, env in CONFIGS:
-        t0 = time.time()
-        try:
-            res = subprocess.run(
-                [sys.executable, "-c", _ITEM, *argv],
-                timeout=timeout_s, capture_output=True, text=True,
-                env={**os.environ, **env},
-            )
-            ok = res.returncode == 0 and "WARM_OK" in res.stdout
-            detail = (res.stdout.strip().splitlines() or [""])[-1] if ok else \
-                (res.stderr or res.stdout).strip().splitlines()[-1:]
-        except subprocess.TimeoutExpired:
-            ok, detail = False, f"timeout {timeout_s:.0f}s"
-        failures += not ok
-        # flush: the session log must stream per-config progress (a redirect
-        # block-buffers prints, hiding everything until exit — observed when
-        # the tunnel died mid-run and the log stayed empty).
-        print(f"{'ok ' if ok else 'FAIL'} {time.time() - t0:7.1f}s  "
-              f"{label}  {detail}", flush=True)
-    return 1 if failures else 0
+    names = sys.argv[1] if len(sys.argv) > 1 else None
+    return warmup_main(names)
 
 
 if __name__ == "__main__":

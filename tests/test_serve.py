@@ -1,0 +1,549 @@
+"""Serve daemon (tpu_tree_search/serve/): admission control, shape-class
+program pooling (zero-recompile warm admissions), checkpoint-based
+preemption bit-identity, SIGTERM drain, and the thin CLI clients.
+
+Everything runs on the virtual CPU platform with small shapes; the
+daemon under test is in-process (port 0) except the SIGTERM drain test,
+which needs a real process to kill."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_tree_search.engine.checkpoint import RunController
+from tpu_tree_search.serve import jobs as serve_jobs
+from tpu_tree_search.serve import pool as serve_pool
+from tpu_tree_search.serve.jobs import JobRegistry, validate_spec
+from tpu_tree_search.serve.scheduler import EnvLease
+from tpu_tree_search.serve.server import ServeDaemon
+
+_FINAL = ("done", "failed", "cancelled")
+
+# One small shape shared across daemon tests: each daemon builds its own
+# problem instance, so distinct shapes would multiply CPU compiles.
+NQ10 = {"problem": "nqueens", "N": 10, "M": 256}
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _wait_final(base, jid, timeout_s=180.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        code, rec = _get(base, f"/job/{jid}")
+        assert code == 200, rec
+        if rec["state"] in _FINAL:
+            return rec
+        time.sleep(0.1)
+    raise AssertionError(f"job {jid} did not finish in {timeout_s}s")
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"))
+    d.start()
+    yield d
+    d.scheduler.drain(timeout_s=30.0)
+    d.close()
+
+
+def _reference(N, M, K=None):
+    """Standalone resident_search on a FRESH problem (what a one-shot
+    `tts run` computes)."""
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    kw = {"K": K} if K is not None else {}
+    return resident_search(NQueensProblem(N=N), m=25, M=M, **kw)
+
+
+# -- spec validation + shape classes (pure host) -----------------------------
+
+
+def test_validate_spec_defaults():
+    spec = validate_spec({"problem": "nqueens"})
+    assert spec["N"] == 14 and spec["g"] == 1 and spec["m"] == 25
+    assert spec["tier"] == "device" and spec["M"] > 0
+    spec = validate_spec({"problem": "pfsp", "lb": "lb2"})
+    assert spec["inst"] == 14 and spec["ub"] == 1
+    assert spec["lb2_variant"] == "full"
+
+
+@pytest.mark.parametrize("bad", [
+    {"problem": "tsp"},
+    {"problem": "nqueens", "tier": "dist"},
+    {"problem": "nqueens", "nope": 1},
+    {"problem": "nqueens", "N": 2},
+    {"problem": "nqueens", "K": 0},
+    {"problem": "nqueens", "K": "fast"},
+    {"problem": "pfsp", "lb2_variant": "lageweg"},  # needs lb=lb2
+    {"problem": "pfsp", "lb": "lb1", "lb2_pairblock": 4},
+    {"problem": "nqueens", "mp": 2},  # mesh-only knob on device tier
+    {"problem": "nqueens", "M": "big"},
+    [1, 2],
+])
+def test_validate_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        validate_spec(bad)
+
+
+def test_class_key_is_stable_and_shape_sensitive():
+    a = serve_pool.class_key(validate_spec(dict(NQ10)))
+    b = serve_pool.class_key(validate_spec(dict(NQ10)))
+    assert a == b
+    c = serve_pool.class_key(validate_spec({**NQ10, "M": 512}))
+    assert c != a
+    d = serve_pool.class_key(validate_spec({**NQ10, "compact": "scatter"}))
+    assert "compact=scatter" in d and d != a
+
+
+def test_class_key_resolves_knobs_without_env_mutation(monkeypatch):
+    monkeypatch.delenv("TTS_COMPACT", raising=False)
+    before = dict(os.environ)
+    spec = validate_spec({"problem": "pfsp", "lb": "lb2", "M": 512,
+                          "lb2_pairblock": "auto"})
+    key = serve_pool.class_key(spec)
+    # auto pairblock resolved to a concrete block size in the token.
+    assert re.search(r"-pb\d+$", key), key
+    assert dict(os.environ) == before
+
+
+def test_identity_sharing_across_classes():
+    pool = serve_pool.ProgramPool()
+    e1 = pool.admit(validate_spec(dict(NQ10)))
+    e2 = pool.admit(validate_spec({**NQ10, "M": 512}))
+    e3 = pool.admit(validate_spec(dict(NQ10)))
+    assert e1.problem is e2.problem  # same identity, different class
+    assert e1 is e3 and e3.jobs_admitted == 2
+
+
+# -- RunController yield seam ------------------------------------------------
+
+
+def test_runcontroller_yield_fn_cuts(tmp_path):
+    calls = []
+
+    class P:  # minimal problem stand-in for problem_meta
+        name = "nqueens"
+        N = 4
+        g = 1
+
+    def yield_fn():
+        calls.append(1)
+        return len(calls) >= 3
+
+    rc = RunController(P(), None, interval_s=1e9, max_steps=None,
+                       snapshot_fn=lambda: (_ for _ in ()).throw(
+                           AssertionError("no snapshot without a path")),
+                       yield_fn=yield_fn)
+    assert rc.after_step(1, 0) is False
+    assert rc.after_step(2, 0) is False
+    assert rc.after_step(3, 0) is True  # yield_fn went true -> cut
+    assert len(calls) == 3  # checked at every dispatch boundary
+    # Without yield_fn or max_steps, never cuts.
+    rc2 = RunController(P(), None, interval_s=1e9, max_steps=None,
+                        snapshot_fn=lambda: None)
+    assert all(not rc2.after_step(i, 0) for i in range(50))
+
+
+# -- env lease ---------------------------------------------------------------
+
+
+def test_env_lease_serializes_conflicting_pins(monkeypatch):
+    monkeypatch.delenv("TTS_TEST_PIN", raising=False)
+    lease = EnvLease()
+    order = []
+    lease.acquire({"TTS_TEST_PIN": "a"})
+    assert os.environ["TTS_TEST_PIN"] == "a"
+    lease.acquire({"TTS_TEST_PIN": "a"})  # identical pins share
+
+    def conflicting():
+        lease.acquire({"TTS_TEST_PIN": "b"})
+        order.append(os.environ["TTS_TEST_PIN"])
+        lease.release()
+
+    t = threading.Thread(target=conflicting, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert not order  # blocked while 'a' holders are live
+    lease.release()
+    lease.release()
+    t.join(timeout=10)
+    assert order == ["b"]
+    assert "TTS_TEST_PIN" not in os.environ  # restored after last release
+
+
+# -- registry durability -----------------------------------------------------
+
+
+def test_registry_durability_reload(tmp_path):
+    reg = JobRegistry(str(tmp_path))
+    spec = validate_spec(dict(NQ10))
+    j1 = reg.create(spec, "cls", {})
+    j2 = reg.create(spec, "cls", {})
+    j3 = reg.create(spec, "cls", {})
+    reg.transition(j1, "done", result={"explored_tree": 1})
+    reg.transition(j2, "running")
+    # j3 stays queued; a new registry on the same dir models a restart.
+    reg2 = JobRegistry(str(tmp_path))
+    assert reg2.load() == 3
+    assert reg2.get(j1.id).state == "done"
+    assert reg2.get(j1.id).result == {"explored_tree": 1}
+    assert reg2.get(j2.id).state == "requeued"  # was running
+    assert reg2.get(j3.id).state == "requeued"  # was queued
+    # New ids continue past the loaded sequence.
+    j4 = reg2.create(spec, "cls", {})
+    assert j4.id > j3.id
+
+
+# -- e2e: submit/stream/result + bit-identity vs the standalone CLI ---------
+
+
+def test_e2e_submit_stream_result_bit_identical_to_cli(daemon, capsys):
+    from tpu_tree_search import cli
+
+    rc = cli.main(["nqueens", "--N", "10", "--M", "256",
+                   "--tier", "device", "--json"])
+    assert rc == 0
+    cli_rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    base = daemon.url
+    code, sub = _post(base, "/submit", NQ10)
+    assert code == 201 and sub["warm"] is False
+    # Stream the job: snapshot frames then the final record as `done`.
+    from tpu_tree_search.obs.live import iter_sse
+
+    frames, final = [], None
+    with urllib.request.urlopen(
+        base + f"/job/{sub['id']}/stream", timeout=180
+    ) as resp:
+        for event, payload in iter_sse(resp):
+            if event == "done":
+                final = payload
+                break
+            frames.append(payload)
+    assert final is not None and final["state"] == "done"
+    assert frames, "expected at least one snapshot frame"
+    assert frames[-1]["tier"] == "resident"
+    assert final["result"]["explored_tree"] == cli_rec["explored_tree"]
+    assert final["result"]["explored_sol"] == cli_rec["explored_sol"]
+    # /result agrees with the stream's terminal frame.
+    code, res = _get(base, f"/job/{sub['id']}/result")
+    assert code == 200 and res["result"] == final["result"]
+
+
+def test_result_conflicts_until_done(daemon):
+    base = daemon.url
+    code, sub = _post(base, "/submit", {**NQ10, "N": 12, "K": 4})
+    code, res = _get(base, f"/job/{sub['id']}/result")
+    assert code == 409 and "state" in res
+    _wait_final(base, sub["id"])
+    code, res = _get(base, f"/job/{sub['id']}/result")
+    assert code == 200
+
+
+def test_unknown_job_and_bad_spec(daemon):
+    base = daemon.url
+    assert _get(base, "/job/nope")[0] == 404
+    code, err = _post(base, "/submit", {"problem": "tsp"})
+    assert code == 400 and "error" in err
+    code, err = _post(base, "/submit", ["not", "a", "dict"])
+    assert code == 400
+
+
+def test_queue_admission_control(tmp_path):
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "s"), max_queue=1)
+    # Scheduler NOT started: jobs stay queued, so the cap is observable.
+    d._http_thread = threading.Thread(
+        target=d._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+        daemon=True)
+    d._http_thread.start()
+    try:
+        base = d.url
+        assert _post(base, "/submit", NQ10)[0] == 201
+        code, err = _post(base, "/submit", NQ10)
+        assert code == 503 and "queue full" in err["error"]
+    finally:
+        d.close()
+
+
+# -- zero-recompile warm-class admission (the tentpole acceptance) -----------
+
+
+def test_second_same_class_job_zero_recompiles_under_guard(
+    tmp_path, monkeypatch
+):
+    # TTS_GUARD=1 for the daemon's whole life: every steady-state dispatch
+    # of every job slice asserts zero recompiles + zero implicit
+    # transfers. A violation fails the job, which fails the test.
+    monkeypatch.setenv("TTS_GUARD", "1")
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"))
+    d.start()
+    try:
+        base = d.url
+        code, s1 = _post(base, "/submit", NQ10)
+        rec1 = _wait_final(base, s1["id"])
+        assert rec1["state"] == "done", rec1["error"]
+        assert rec1["new_programs"] >= 1  # cold class compiled
+        code, s2 = _post(base, "/submit", NQ10)
+        assert s2["warm"] is True and s2["class"] == s1["class"]
+        rec2 = _wait_final(base, s2["id"])
+        assert rec2["state"] == "done", rec2["error"]
+        # The acceptance criterion: a warm-class admission compiles
+        # NOTHING — no new program-cache entries, no new jit entries.
+        assert rec2["new_programs"] == 0
+        assert rec2["new_step_compiles"] == 0
+        assert rec2["result"]["explored_tree"] == rec1["result"]["explored_tree"]
+        code, classes = _get(base, "/classes")
+        entry = next(c for c in classes if c["class"] == s1["class"])
+        assert entry["warm"] and entry["jobs_admitted"] == 2
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
+# -- preemption --------------------------------------------------------------
+
+
+def test_preempt_resume_bit_identity(tmp_path):
+    ref = _reference(N=11, M=256, K=4)
+    # quantum=0: every dispatch boundary with waiting work preempts.
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"), quantum_s=0.0)
+    d.start()
+    try:
+        base = d.url
+        code, p1 = _post(base, "/submit",
+                         {"problem": "nqueens", "N": 11, "M": 256, "K": 4})
+        code, p2 = _post(base, "/submit", {**NQ10, "K": 4})
+        rec1 = _wait_final(base, p1["id"])
+        rec2 = _wait_final(base, p2["id"])
+        assert rec1["state"] == "done" and rec2["state"] == "done"
+        assert rec1["preemptions"] > 0, "quantum=0 with a queue must preempt"
+        assert rec1["slices"] == rec1["preemptions"] + 1
+        # Preempted-and-resumed totals == the uninterrupted run's, exactly.
+        assert rec1["result"]["explored_tree"] == ref.explored_tree
+        assert rec1["result"]["explored_sol"] == ref.explored_sol
+        assert rec1["result"]["best"] == ref.best
+        # Checkpoints are consumed: nothing dangling after completion.
+        assert rec1["checkpoint"] is None
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
+def test_cancel_running_job(daemon):
+    base = daemon.url
+    code, sub = _post(base, "/submit",
+                      {"problem": "nqueens", "N": 13, "M": 256, "K": 2})
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        _, rec = _get(base, f"/job/{sub['id']}")
+        if rec["state"] == "running":
+            break
+        time.sleep(0.05)
+    assert rec["state"] == "running"
+    code, resp = _post(base, f"/job/{sub['id']}/cancel", {})
+    assert code == 200
+    rec = _wait_final(base, sub["id"])
+    assert rec["state"] == "cancelled"
+    # Partial progress is reported (complete=False counters).
+    assert rec["result"] is None or rec["result"]["complete"] is False
+    # Cancelling again: already terminal.
+    code, resp = _post(base, f"/job/{sub['id']}/cancel", {})
+    assert code == 409
+
+
+def test_cancel_queued_job_never_runs(tmp_path):
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "s"))
+    # No scheduler: the job stays queued.
+    d._http_thread = threading.Thread(
+        target=d._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+        daemon=True)
+    d._http_thread.start()
+    try:
+        base = d.url
+        _, sub = _post(base, "/submit", NQ10)
+        code, _resp = _post(base, f"/job/{sub['id']}/cancel", {})
+        assert code == 200
+        _, rec = _get(base, f"/job/{sub['id']}")
+        assert rec["state"] == "cancelled" and rec["slices"] == 0
+    finally:
+        d.close()
+
+
+# -- concurrent multi-tenant smoke ------------------------------------------
+
+
+def test_three_concurrent_jobs_bit_identical(daemon):
+    refs = {N: _reference(N=N, M=256) for N in (9, 10, 11)}
+    base = daemon.url
+    subs = {}
+    for N in (11, 9, 10):  # deliberately not id order
+        _, sub = _post(base, "/submit",
+                       {"problem": "nqueens", "N": N, "M": 256})
+        subs[N] = sub["id"]
+    for N, jid in subs.items():
+        rec = _wait_final(base, jid)
+        assert rec["state"] == "done", rec["error"]
+        assert rec["result"]["explored_tree"] == refs[N].explored_tree
+        assert rec["result"]["explored_sol"] == refs[N].explored_sol
+    _, health = _get(base, "/healthz")
+    assert health["ok"] and health["jobs"] == 3
+
+
+# -- SIGTERM drain (subprocess) ---------------------------------------------
+
+
+def test_sigterm_drains_running_job_to_requeued(tmp_path):
+    """The daemon's graceful-drain contract: SIGTERM with a job in flight
+    cuts it at the next dispatch boundary (checkpoint written), marks it
+    requeued, dumps the flight recorder (TTS_FLIGHTREC composition), and
+    exits 0."""
+    state = tmp_path / "state"
+    prefix = tmp_path / "fr"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "TTS_FLIGHTREC": str(prefix)}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_tree_search.cli", "serve", "--port", "0",
+         "--state-dir", str(state)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+    try:
+        banner = proc.stdout.readline()
+        m = re.search(r"http://127\.0\.0\.1:(\d+)", banner)
+        assert m, banner
+        base = f"http://127.0.0.1:{m.group(1)}"
+        _, sub = _post(base, "/submit",
+                       {"problem": "nqueens", "N": 13, "M": 256, "K": 2})
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            _, rec = _get(base, f"/job/{sub['id']}")
+            if rec["state"] == "running":
+                break
+            time.sleep(0.1)
+        assert rec["state"] == "running"
+        time.sleep(1.0)  # let some dispatches land
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=90)
+        assert rc == 0, proc.stdout.read()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    # Durable record: requeued, with a live checkpoint to resume from.
+    rec = json.load(open(state / "jobs" / f"{sub['id']}.json"))
+    assert rec["state"] == "requeued"
+    assert rec["checkpoint"] and os.path.exists(rec["checkpoint"])
+    # Flight-recorder SIGTERM dump composed with the drain handler.
+    assert (tmp_path / "fr.trace.json").exists()
+
+
+# -- warmup ------------------------------------------------------------------
+
+
+def test_warmup_select_configs():
+    from tpu_tree_search.serve import warmup
+
+    assert len(warmup.select_configs(None)) == len(warmup.CONFIGS)
+    serveable = warmup.select_configs("serve")
+    assert serveable and all(c.servable for c in serveable)
+    two = warmup.select_configs("ta014-lb1,nqueens-15")
+    assert [c.name for c in two] == ["ta014-lb1", "nqueens-15"]
+    with pytest.raises(ValueError):
+        warmup.select_configs("no-such-config")
+    # Every serve-able config produces a valid spec (admission-compatible).
+    for cfg in serveable:
+        validate_spec(cfg.spec())
+
+
+def test_warmup_main_rejects_unknown_names(capsys):
+    from tpu_tree_search.serve.warmup import warmup_main
+
+    assert warmup_main("definitely-not-a-config") == 2
+    assert "unknown warm config" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_warmup_hit_miss_accounting(tmp_path, monkeypatch):
+    """A config's first subprocess run banks new compile-cache files
+    (miss); an identical second run compiles nothing (hit)."""
+    from tpu_tree_search.serve.warmup import WarmConfig, run_configs
+
+    monkeypatch.setenv("TTS_COMPILE_CACHE", str(tmp_path / "xla"))
+    # CPU test compiles are sub-second; drop the persistence floor so
+    # they land in the cache and the delta is observable.
+    monkeypatch.setenv("TTS_WARM_MIN_COMPILE_S", "0")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    cfg = [WarmConfig("tiny", "tiny nqueens", ["nqueens", "8", "64"])]
+    lines = []
+    assert run_configs(cfg, timeout_s=300, emit=lines.append) == 0
+    assert re.search(r"miss\(\+\d+ files\)", lines[0]), lines
+    lines2 = []
+    assert run_configs(cfg, timeout_s=300, emit=lines2.append) == 0
+    assert "[hit]" in lines2[0], lines2
+
+
+# -- CLI surface -------------------------------------------------------------
+
+
+def test_cli_submit_requires_run_command(capsys):
+    from tpu_tree_search import cli
+
+    with pytest.raises(SystemExit):
+        cli.main(["submit"])
+    with pytest.raises(SystemExit):
+        cli.main(["submit", "--", "watch"])
+
+
+def test_cli_submit_and_watch_job_roundtrip(daemon, capsys):
+    from tpu_tree_search import cli
+
+    rc = cli.main(["submit", "--port", str(daemon.port), "--wait", "--json",
+                   "--", "nqueens", "--N", "10", "--M", "256"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert rc == 0
+    rec = json.loads(out)
+    assert rec["state"] == "done"
+    assert rec["result"]["explored_tree"] > 0
+    # seq (the parser default) submits as the device tier.
+    assert rec["spec"]["tier"] == "device"
+    rc = cli.main(["watch", "--job", rec["id"], "--port", str(daemon.port),
+                   "--json"])
+    assert rc == 0
+    watched = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert watched["id"] == rec["id"] and watched["state"] == "done"
+
+
+def test_cli_watch_job_unreachable():
+    from tpu_tree_search import cli
+
+    # A port nothing listens on: clean error exit, no traceback.
+    assert cli.main(["watch", "--job", "job-000001",
+                     "--port", "1"]) == 2

@@ -247,12 +247,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     watch = sub.add_parser(
         "watch",
-        help="live view of a run started with --obs-serve PORT: one "
-        "status line per snapshot (nodes/s, incumbent, pool occupancy, "
-        "pipeline depth/K)",
+        help="live view of a run started with --obs-serve PORT (or, with "
+        "--job, of one serve-daemon job): one status line per snapshot "
+        "(nodes/s, incumbent, pool occupancy, pipeline depth/K)",
     )
-    watch.add_argument("--port", type=int, default=8642,
-                       help="the --obs-serve port (default 8642)")
+    watch.add_argument("--port", type=int, default=None,
+                       help="the --obs-serve port (default 8642), or with "
+                       "--job the serve daemon's port (default 8643)")
     watch.add_argument("--host", type=str, default="127.0.0.1")
     watch.add_argument("--interval", type=float, default=1.0,
                        help="polling fallback interval in seconds")
@@ -260,6 +261,72 @@ def build_parser() -> argparse.ArgumentParser:
                        help="print the current snapshot and exit")
     watch.add_argument("--json", action="store_true", dest="watch_json",
                        help="emit raw snapshot JSON lines")
+    watch.add_argument("--job", type=str, default=None, metavar="ID",
+                       help="follow one serve-daemon job's stream instead "
+                       "of a --obs-serve run (docs/SERVING.md)")
+
+    from .serve import DEFAULT_PORT as _SERVE_PORT
+
+    srv = sub.add_parser(
+        "serve",
+        help="persistent multi-tenant search daemon: admit jobs over a "
+        "localhost HTTP/JSON API, pool compiled programs per shape "
+        "class (second same-class job admits with zero recompiles), "
+        "preempt via bit-identical checkpoint cuts (docs/SERVING.md)",
+    )
+    srv.add_argument("--port", type=int, default=_SERVE_PORT,
+                     help=f"listen port on 127.0.0.1 (default {_SERVE_PORT}; "
+                     "0 = OS-assigned, printed at startup)")
+    srv.add_argument("--host", type=str, default="127.0.0.1")
+    srv.add_argument("--state-dir", type=str, default=None,
+                     help="durable job records + checkpoints (default "
+                     "TTS_SERVE_STATE or ~/.cache/tpu_tree_search/serve)")
+    srv.add_argument("--workers", type=int, default=1,
+                     help="concurrent job slices (default 1: one resident "
+                     "loop owns the accelerator at a time)")
+    srv.add_argument("--quantum", type=float, default=5.0,
+                     help="seconds a job runs before it must yield to "
+                     "waiting work (checkpoint cut + requeue; the cut "
+                     "lands at the next dispatch boundary)")
+    srv.add_argument("--max-queue", type=int, default=64,
+                     help="admission control: reject submits (503) beyond "
+                     "this queue depth")
+    srv.add_argument("--warm", type=str, nargs="?", const="serve",
+                     default=None, metavar="NAMES",
+                     help="pre-warm the program pool at startup: 'serve' "
+                     "(every serve-able config), 'all', or a "
+                     "comma-separated config list (`tts warmup` names)")
+
+    smt = sub.add_parser(
+        "submit",
+        help="submit a run to a serve daemon: `tts submit [--wait] -- "
+        "pfsp --inst 14 --tier device` (the run args are the normal "
+        "`tts` run command; --wait streams to completion)",
+    )
+    smt.add_argument("--port", type=int, default=_SERVE_PORT,
+                     help=f"serve daemon port (default {_SERVE_PORT})")
+    smt.add_argument("--host", type=str, default="127.0.0.1")
+    smt.add_argument("--wait", action="store_true",
+                     help="follow the job's stream and print the final "
+                     "result (exit 1 unless it completes)")
+    smt.add_argument("--json", action="store_true", dest="submit_json",
+                     help="emit the submit response (or with --wait the "
+                     "final job record) as one JSON line")
+    smt.add_argument("rest", nargs=argparse.REMAINDER,
+                     help="a full run command (problem + flags)")
+
+    wrm = sub.add_parser(
+        "warmup",
+        help="AOT-compile the validation matrix into the persistent "
+        "compile cache with per-config hit/miss reporting "
+        "(scripts/warm_cache.py's engine; docs/SERVING.md)",
+    )
+    wrm.add_argument("--configs", type=str, default=None, metavar="NAMES",
+                     help="'all' (default), 'serve', or a comma-separated "
+                     "config name list")
+    wrm.add_argument("--timeout", type=float, default=None,
+                     help="per-config subprocess timeout in seconds "
+                     "(default TTS_WARM_TIMEOUT or 420)")
     return p
 
 
@@ -838,7 +905,8 @@ def main(argv=None) -> int:
                 "`tts profile pfsp --inst 14 --tier device`"
             )
         args = parser.parse_args(rest)
-        if args.problem in ("lint", "check", "report", "watch", "profile"):
+        if args.problem in ("lint", "check", "report", "watch", "profile",
+                            "serve", "submit", "warmup"):
             parser.error("profile wraps a search run, not another "
                          "subcommand")
         args.phase_profile = True
@@ -858,12 +926,57 @@ def main(argv=None) -> int:
 
         return report_main(args.trace, as_json=args.report_json)
     if args.problem == "watch":
+        if args.job is not None:
+            # Pure HTTP client of a serve daemon: no jax import.
+            from .serve import DEFAULT_PORT
+            from .serve.client import watch_job_main
+
+            return watch_job_main(
+                args.job, port=args.port or DEFAULT_PORT, host=args.host,
+                once=args.once, as_json=args.watch_json,
+            )
         # Pure HTTP client of a --obs-serve run: no jax import.
         from .obs.live import watch_main
 
-        return watch_main(args.port, host=args.host,
+        return watch_main(args.port or 8642, host=args.host,
                           interval=args.interval, once=args.once,
                           as_json=args.watch_json)
+    if args.problem == "serve":
+        # The daemon: jax stays out of the HTTP threads (scheduler
+        # workers import the engines lazily on the first slice).
+        from .serve.server import serve_main
+
+        enable_compile_cache()
+        return serve_main(port=args.port, host=args.host,
+                          state_dir=args.state_dir, workers=args.workers,
+                          quantum_s=args.quantum, max_queue=args.max_queue,
+                          warm=args.warm)
+    if args.problem == "submit":
+        # Thin client: re-parse the run command through THIS parser so
+        # every CLI-side validation runs before the spec leaves the
+        # process (same REMAINDER trick as `tts profile`); no jax import.
+        rest = [a for a in args.rest if a != "--"]
+        if not rest:
+            parser.error(
+                "submit: pass a full run command, e.g. "
+                "`tts submit -- pfsp --inst 14 --tier device`"
+            )
+        run_args = parser.parse_args(rest)
+        if run_args.problem not in ("nqueens", "pfsp"):
+            parser.error("submit wraps a search run, not another "
+                         "subcommand")
+        validate_args(parser, run_args)
+        from .serve.client import spec_from_args, submit_main
+
+        return submit_main(spec_from_args(run_args), port=args.port,
+                           host=args.host, wait=args.wait,
+                           as_json=args.submit_json)
+    if args.problem == "warmup":
+        # Subprocess orchestration: each config compiles in its own
+        # process against the persistent cache; no jax import here.
+        from .serve.warmup import warmup_main
+
+        return warmup_main(args.configs, timeout_s=args.timeout)
     validate_args(parser, args)
     primary = True
     if args.distributed:

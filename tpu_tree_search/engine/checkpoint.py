@@ -45,7 +45,17 @@ class RunController:
     counts or the saved counters would lag the saved frontier (a resumed
     run would under-count).  Called exactly once, right before a snapshot
     is taken; the engine's drain also folds the increments into its own
-    running totals."""
+    running totals.
+
+    ``yield_fn() -> bool``: cooperative preemption (the serve daemon's
+    seam, ``tpu_tree_search/serve/``). Checked at every dispatch boundary
+    like the ``max_steps`` cutoff; returning True cuts the run NOW — the
+    queue drains, the frontier snapshots, the checkpoint (if a path is
+    set) is written — and the engine returns ``complete=False``. A
+    resumed search from that cut reproduces the uninterrupted result
+    bit-for-bit (the frontier + incumbent + counters are the complete
+    search state), which is what makes preemption safe to impose on a
+    tenant's job."""
 
     def __init__(
         self,
@@ -55,6 +65,7 @@ class RunController:
         max_steps: int | None,
         snapshot_fn,
         drain_fn=None,
+        yield_fn=None,
     ):
         import time
 
@@ -64,6 +75,7 @@ class RunController:
         self.max_steps = max_steps
         self.snapshot_fn = snapshot_fn
         self.drain_fn = drain_fn
+        self.yield_fn = yield_fn
         self.steps = 0
         self._clock = time.monotonic
         self._last = self._clock()
@@ -78,7 +90,10 @@ class RunController:
 
     def after_step(self, tree: int, sol: int) -> bool:
         self.steps += 1
-        if self.max_steps is not None and self.steps >= self.max_steps:
+        cut = self.max_steps is not None and self.steps >= self.max_steps
+        if not cut and self.yield_fn is not None:
+            cut = bool(self.yield_fn())
+        if cut:
             if self.path is not None:
                 self._save(tree, sol)
             return True

@@ -691,6 +691,7 @@ def resident_search(
     checkpoint_interval_s: float = 60.0,
     resume_from: str | None = None,
     guard: bool | None = None,
+    yield_fn=None,
 ) -> SearchResult:
     """3-phase search with a device-resident hot loop.
 
@@ -712,7 +713,11 @@ def resident_search(
     ``checkpoint_path`` the live frontier + counters are saved every
     ``checkpoint_interval_s`` and at a ``max_steps`` cutoff (which returns
     ``complete=False``); ``resume_from`` seeds the search from a saved file
-    and keeps counting.
+    and keeps counting. ``yield_fn`` is the cooperative-preemption seam
+    (checkpoint.RunController): checked at every dispatch boundary, True
+    cuts the run exactly like a ``max_steps`` cutoff — the serve daemon
+    uses it to make a long job yield to its queue and resume
+    bit-identically (``tpu_tree_search/serve/``).
 
     Guard mode (``guard=True`` or TTS_GUARD=1, docs/ANALYSIS.md): every
     steady-state dispatch is asserted to reuse the compiled step (zero
@@ -891,7 +896,7 @@ def resident_search(
 
     controller = ckpt.RunController(
         problem, checkpoint_path, checkpoint_interval_s, max_steps,
-        snapshot_fn, drain_fn=drain_queue,
+        snapshot_fn, drain_fn=drain_queue, yield_fn=yield_fn,
     )
 
     fr.arm("resident")

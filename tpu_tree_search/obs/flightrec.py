@@ -151,7 +151,13 @@ class FlightRecorder:
     """
 
     def __init__(self, ring: int = RING_SNAPSHOTS,
-                 snapshot_period_us: float = SNAPSHOT_PERIOD_US):
+                 snapshot_period_us: float = SNAPSHOT_PERIOD_US,
+                 always_on: bool = False):
+        # always_on: a privately-owned recorder (the serve daemon binds one
+        # per job) records regardless of the TTS_FLIGHTREC/TTS_OBS knobs —
+        # the binding itself is the opt-in; it never installs process-wide
+        # dump hooks.
+        self.always_on = always_on
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=ring)  # guarded-by: _lock
         self._last: dict = {}  # guarded-by: _lock -- (host, wid) -> dispatch
@@ -179,7 +185,7 @@ class FlightRecorder:
         ``phases`` is the run's per-phase ns totals so far (TTS_PHASEPROF
         armed runs) — a watchdog post-mortem then names where the last
         dispatch was spending its cycles."""
-        if not enabled():
+        if not (self.always_on or enabled()):
             return
         now = ev.now_us()
         self._last_beat = time.monotonic()
@@ -214,7 +220,7 @@ class FlightRecorder:
     def set_idle(self, host: int, wid: int, idle: bool) -> None:
         """Worker idle-state transitions (the offload tiers' busy<->idle
         edges — same call sites as their ``idle`` spans)."""
-        if not enabled():
+        if not (self.always_on or enabled()):
             return
         with self._lock:
             if idle:
@@ -375,14 +381,55 @@ class FlightRecorder:
 
 _REC = FlightRecorder()
 
+#: Thread-bound recorder override (``bound()``): the serve daemon runs many
+#: tenant jobs in one process and namespaces each job's telemetry by
+#: binding a private recorder around the engine call — the engines keep
+#: calling the same module-level ``heartbeat``/``set_idle`` hooks, and the
+#: binding routes them. Thread-local because jobs run on scheduler worker
+#: threads; an unbound thread (every standalone run) uses the global
+#: recorder exactly as before.
+_TLS = threading.local()
+
 
 def recorder() -> FlightRecorder:
     return _REC
 
 
+def current() -> FlightRecorder:
+    """The recorder this thread's heartbeats land in: the ``bound()``
+    recorder when inside a binding, else the process-global one."""
+    return getattr(_TLS, "rec", None) or _REC
+
+
+class bound:
+    """Context manager: route this thread's heartbeats/idle edges into
+    ``rec`` (re-entrant; restores the previous binding on exit)."""
+
+    def __init__(self, rec: FlightRecorder):
+        self.rec = rec
+        self._prev: FlightRecorder | None = None
+
+    def __enter__(self) -> FlightRecorder:
+        self._prev = getattr(_TLS, "rec", None)
+        _TLS.rec = self.rec
+        return self.rec
+
+    def __exit__(self, *exc) -> None:
+        _TLS.rec = self._prev
+
+
 def arm(tier: str | None = None) -> bool:
     """Engine entry hook: install the dump triggers if recording is
-    enabled (cheap no-op otherwise) and note the run's tier."""
+    enabled (cheap no-op otherwise) and note the run's tier. Under a
+    ``bound()`` recorder the tier lands on the binding and no process-wide
+    hooks are touched — a tenant job must not re-point the daemon's signal
+    handlers or watchdog."""
+    rec = getattr(_TLS, "rec", None)
+    if rec is not None:
+        if tier is not None:
+            with rec._lock:
+                rec._meta["tier"] = tier
+        return True
     ok = _REC.install()
     if ok and tier is not None:
         with _REC._lock:
@@ -391,11 +438,11 @@ def arm(tier: str | None = None) -> bool:
 
 
 def heartbeat(*args, **kw) -> None:
-    _REC.heartbeat(*args, **kw)
+    current().heartbeat(*args, **kw)
 
 
 def set_idle(host: int, wid: int, idle: bool) -> None:
-    _REC.set_idle(host, wid, idle)
+    current().set_idle(host, wid, idle)
 
 
 def snapshots(n: int | None = None) -> list[dict]:

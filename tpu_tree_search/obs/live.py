@@ -81,22 +81,86 @@ class _Handler(BaseHTTPRequestHandler):
             pass  # client went away; nothing to clean up
 
     def _stream(self) -> None:
-        self.send_response(200)
-        self.send_header("Content-Type", "text/event-stream")
-        self.send_header("Cache-Control", "no-cache")
-        self.end_headers()
-        self.wfile.write(b": tts live snapshot stream\n\n")
-        self.wfile.flush()
-        last_ts = None
-        while not getattr(self.server, "closing", False):
-            snap = flightrec.latest()
-            if snap is not None and snap.get("ts_us") != last_ts:
-                last_ts = snap.get("ts_us")
-                self.wfile.write(
-                    b"data: " + json.dumps(snap).encode() + b"\n\n"
-                )
-                self.wfile.flush()
-            time.sleep(STREAM_POLL_S)
+        sse_begin(self)
+        stream_snapshots(
+            self, flightrec.latest,
+            stop_fn=lambda: getattr(self.server, "closing", False),
+        )
+
+
+# -- SSE plumbing (shared with the serve daemon's per-job streams) ----------
+
+
+def sse_begin(handler: BaseHTTPRequestHandler, comment: str = "tts snapshot stream") -> None:
+    """Open a Server-Sent-Events response on ``handler``."""
+    handler.send_response(200)
+    handler.send_header("Content-Type", "text/event-stream")
+    handler.send_header("Cache-Control", "no-cache")
+    handler.end_headers()
+    handler.wfile.write(b": " + comment.encode() + b"\n\n")
+    handler.wfile.flush()
+
+
+def sse_event(handler: BaseHTTPRequestHandler, payload: dict,
+              event: str | None = None) -> None:
+    """One SSE frame (optionally named via ``event:``)."""
+    buf = b""
+    if event:
+        buf += b"event: " + event.encode() + b"\n"
+    buf += b"data: " + json.dumps(payload).encode() + b"\n\n"
+    handler.wfile.write(buf)
+    handler.wfile.flush()
+
+
+def stream_snapshots(handler: BaseHTTPRequestHandler, latest_fn,
+                     stop_fn=None, poll_s: float = STREAM_POLL_S,
+                     final_fn=None) -> None:
+    """Poll ``latest_fn()`` and push each NEW snapshot (by ``ts_us``) as an
+    SSE frame until ``stop_fn()`` goes true. ``final_fn()`` (optional) may
+    return one terminal payload, sent as an ``event: done`` frame — the
+    serve daemon closes a finished job's stream with its result record so
+    a client needs no second round trip."""
+    last_ts = None
+
+    def push_new() -> None:
+        nonlocal last_ts
+        snap = latest_fn()
+        if snap is not None and snap.get("ts_us") != last_ts:
+            last_ts = snap.get("ts_us")
+            sse_event(handler, snap)
+
+    while not (stop_fn is not None and stop_fn()):
+        push_new()
+        time.sleep(poll_s)
+    # Flush the frame that may have landed during the last sleep — a fast
+    # job's only snapshot must not lose the race with its own completion.
+    push_new()
+    if final_fn is not None:
+        payload = final_fn()
+        if payload is not None:
+            sse_event(handler, payload, event="done")
+
+
+def iter_sse(resp):
+    """Client side: yield ``(event, payload)`` per SSE frame from an open
+    ``urlopen`` response (``event`` is None for plain ``data:`` frames;
+    unparseable frames are skipped)."""
+    event = None
+    for raw in resp:
+        line = raw.decode(errors="replace").strip()
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+            continue
+        if not line.startswith("data: "):
+            if not line:
+                event = None  # frame boundary
+            continue
+        try:
+            payload = json.loads(line[len("data: "):])
+        except ValueError:
+            continue
+        yield event, payload
+        event = None
 
 
 class LiveServer:
@@ -196,14 +260,7 @@ def watch_main(port: int, host: str = "127.0.0.1", interval: float = 1.0,
     try:
         try:
             with urlopen(base + "/stream", timeout=30.0) as resp:  # noqa: S310
-                for raw in resp:
-                    line = raw.decode(errors="replace").strip()
-                    if not line.startswith("data: "):
-                        continue
-                    try:
-                        snap = json.loads(line[len("data: "):])
-                    except ValueError:
-                        continue
+                for _event, snap in iter_sse(resp):
                     emit(snap)
                     seen += 1
                     if max_updates is not None and seen >= max_updates:

@@ -505,11 +505,15 @@ def mesh_resident_search(
     checkpoint_interval_s: float = 60.0,
     resume_from: str | None = None,
     guard: bool | None = None,
+    yield_fn=None,
 ) -> SearchResult:
     """SPMD multi-device search: 3 phases like every tier, with phase 2 one
     sharded resident program (see module docstring). Checkpoint/resume as in
     ``resident_search`` (a mesh snapshot merges every shard's frontier, and a
-    resumed frontier re-partitions stride-D, so D may change across runs).
+    resumed frontier re-partitions stride-D, so D may change across runs);
+    ``yield_fn`` is the same cooperative-preemption seam (a True return
+    cuts the run at the next dispatch boundary like a ``max_steps``
+    cutoff — the serve daemon's scheduler rides it).
     ``guard``/TTS_GUARD=1 asserts zero recompiles + zero implicit transfers
     per steady-state dispatch, exactly as in ``resident_search``. Dispatch
     is pipelined (TTS_PIPELINE) and ``K="auto"``/TTS_K=auto enables the
@@ -701,7 +705,7 @@ def mesh_resident_search(
 
     controller = ckpt.RunController(
         problem, checkpoint_path, checkpoint_interval_s, max_steps,
-        snapshot_fn, drain_fn=drain_queue,
+        snapshot_fn, drain_fn=drain_queue, yield_fn=yield_fn,
     )
 
     fr.arm("mesh")

@@ -1,0 +1,34 @@
+"""``tts serve`` — persistent multi-tenant search daemon.
+
+The serve package turns the one-shot CLI into a resident service
+(ROADMAP item 2, the search-as-a-service direction of arXiv:2002.07062):
+one long-lived process owns the accelerator, admits search jobs over a
+localhost HTTP/JSON API, and keeps every compiled program alive between
+jobs so repeat work pays zero compile seconds.
+
+Layout (each module owns one concern):
+
+  * ``jobs.py``      — job specs (validated JSON), the Job record, and the
+    durable on-disk registry (submit/status/result survive a restart);
+  * ``pool.py``      — shape-class admission control: requests map to a
+    (problem family, shape, bound variant, knob-resolved token) class and
+    share one problem instance per identity, so a second same-class job
+    admits with **zero recompiles** (TTS_GUARD green);
+  * ``scheduler.py`` — worker threads + checkpoint-based preemption
+    (``RunController`` ``yield_fn`` drain -> cut -> resume, bit-identical)
+    and the env-knob lease that serializes conflicting per-job pins;
+  * ``server.py``    — the stdlib HTTP/SSE daemon (same zero-dep pattern
+    as ``obs/live.py``) and graceful SIGTERM drain;
+  * ``client.py``    — ``tts submit`` / ``tts watch --job`` thin clients;
+  * ``warmup.py``    — the AOT warm matrix (``scripts/warm_cache.py``
+    promoted to an importable module) + per-class hit/miss reporting.
+
+Everything is stdlib-only on the serving path; jax is imported lazily by
+the scheduler workers, never by the clients.
+"""
+
+from __future__ import annotations
+
+DEFAULT_PORT = 8643  # one above obs/live's default watch port
+
+__all__ = ["DEFAULT_PORT"]

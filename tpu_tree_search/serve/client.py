@@ -1,0 +1,203 @@
+"""Thin clients for the serve daemon: ``tts submit`` / ``tts watch --job``.
+
+Pure stdlib HTTP (urllib) against 127.0.0.1 — no jax import on any path
+here, same discipline as ``obs/live.watch_main``. The submit client
+converts CLI run arguments into a job spec (reusing the main parser's
+validation via ``tts submit -- <run args>``), posts it, and either
+returns the id immediately or follows the job's SSE stream to completion.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from urllib.error import HTTPError, URLError
+from urllib.request import Request, urlopen
+
+from ..obs.live import format_snapshot, iter_sse
+from . import DEFAULT_PORT
+
+_FINAL = ("done", "failed", "cancelled")
+
+
+def _post(url: str, payload: dict, timeout: float = 10.0) -> tuple[int, dict]:
+    body = json.dumps(payload).encode()
+    req = Request(url, data=body,
+                  headers={"Content-Type": "application/json"})
+    try:
+        with urlopen(req, timeout=timeout) as resp:  # noqa: S310 — localhost
+            return resp.status, json.loads(resp.read().decode())
+    except HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except ValueError:
+            return e.code, {"error": str(e)}
+
+
+def _get(url: str, timeout: float = 10.0) -> tuple[int, dict]:
+    try:
+        with urlopen(url, timeout=timeout) as resp:  # noqa: S310
+            return resp.status, json.loads(resp.read().decode())
+    except HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except ValueError:
+            return e.code, {"error": str(e)}
+
+
+def spec_from_args(args) -> dict:
+    """A job spec from parsed CLI run arguments (the submit path re-parses
+    ``<run args>`` through ``cli.build_parser`` first, so every CLI-side
+    validation already ran)."""
+    # The run parser defaults to --tier seq; the daemon only runs the
+    # preemptible resident tiers, so an unspecified/seq tier submits as
+    # the device tier (the daemon's natural unit of work).
+    tier = "device" if args.tier == "seq" else args.tier
+    spec = {"problem": args.problem, "tier": tier, "m": args.m}
+    if args.M is not None:
+        spec["M"] = args.M
+    if args.K is not None:
+        spec["K"] = args.K
+    if args.problem == "nqueens":
+        spec.update(N=args.N, g=args.g)
+    else:
+        spec.update(inst=args.inst, lb=args.lb, ub=args.ub)
+        if args.lb2_variant != "full":
+            spec["lb2_variant"] = args.lb2_variant
+        if args.lb2_pairblock is not None:
+            pb = args.lb2_pairblock
+            spec["lb2_pairblock"] = pb if pb == "auto" else int(pb)
+    if args.tier == "mesh":
+        if args.D is not None:
+            spec["D"] = args.D
+        if args.mp != 1:
+            spec["mp"] = args.mp
+    if args.compact is not None:
+        spec["compact"] = args.compact
+    if args.max_steps is not None:
+        spec["max_steps"] = args.max_steps
+    return spec
+
+
+def submit_main(spec: dict, port: int = DEFAULT_PORT,
+                host: str = "127.0.0.1", wait: bool = False,
+                as_json: bool = False) -> int:
+    """Submit a job; with ``wait`` follow it to completion (result record
+    printed — the serve analogue of a ``tts run --json`` line)."""
+    base = f"http://{host}:{port}"
+    try:
+        code, payload = _post(base + "/submit", spec)
+    except URLError as e:
+        print(f"Error: no serve daemon at {base}: {e}", file=sys.stderr)
+        return 2
+    if code != 201:
+        print(f"Error: submit rejected ({code}): "
+              f"{payload.get('error', payload)}", file=sys.stderr)
+        return 2
+    if not wait:
+        if as_json:
+            print(json.dumps(payload))
+        else:
+            print(f"{payload['id']}  class={payload['class']}"
+                  f"{' (warm)' if payload.get('warm') else ''}"
+                  f"  position={payload['position']}")
+        return 0
+    rec = follow_job(base, payload["id"],
+                     emit=None if as_json else
+                     (lambda s: print(format_snapshot(s), flush=True)))
+    if rec is None:
+        print(f"Error: lost job {payload['id']}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(rec))
+    else:
+        _print_final(rec)
+    return 0 if rec.get("state") == "done" else 1
+
+
+def _print_final(rec: dict) -> None:
+    res = rec.get("result") or {}
+    print(f"{rec['id']}: {rec['state']}"
+          + (f"  tree={res.get('explored_tree')} "
+             f"sol={res.get('explored_sol')} best={res.get('best')}"
+             if res else "")
+          + (f"  error={rec['error']}" if rec.get("error") else ""))
+
+
+def follow_job(base: str, jid: str, emit=None, timeout_s: float = 600.0):
+    """Stream a job's SSE until its ``done`` frame; fall back to polling
+    if the stream drops (daemon restart). Returns the final job record or
+    None."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            req = base + f"/job/{jid}/stream"
+            with urlopen(req, timeout=timeout_s) as resp:  # noqa: S310
+                for event, payload in iter_sse(resp):
+                    if event == "done":
+                        return payload
+                    if emit is not None:
+                        emit(payload)
+        except (OSError, ValueError):
+            pass
+        # Stream dropped: poll the record directly.
+        try:
+            code, rec = _get(base + f"/job/{jid}")
+        except URLError:
+            return None
+        if code == 200 and rec.get("state") in _FINAL:
+            return rec
+        if code == 404:
+            return None
+        time.sleep(0.5)
+    return None
+
+
+def watch_job_main(jid: str, port: int = DEFAULT_PORT,
+                   host: str = "127.0.0.1", once: bool = False,
+                   as_json: bool = False,
+                   max_updates: int | None = None) -> int:
+    """``tts watch --job <id>``: live per-job stream from the daemon."""
+    base = f"http://{host}:{port}"
+    try:
+        code, rec = _get(base + f"/job/{jid}")
+    except URLError as e:
+        print(f"Error: no serve daemon at {base}: {e}", file=sys.stderr)
+        return 2
+    if code != 200:
+        print(f"Error: unknown job {jid}", file=sys.stderr)
+        return 2
+    emit = (lambda s: print(json.dumps(s), flush=True)) if as_json else (
+        lambda s: print(format_snapshot(s), flush=True)
+    )
+    if once or rec.get("state") in _FINAL:
+        if as_json:
+            print(json.dumps(rec))
+        else:
+            _print_final(rec) if rec.get("state") in _FINAL else print(
+                f"{rec['id']}: {rec['state']}"
+            )
+        return 0
+    seen = 0
+    try:
+        req = base + f"/job/{jid}/stream"
+        with urlopen(req, timeout=600.0) as resp:  # noqa: S310
+            for event, payload in iter_sse(resp):
+                if event == "done":
+                    if as_json:
+                        print(json.dumps(payload))
+                    else:
+                        _print_final(payload)
+                    return 0
+                emit(payload)
+                seen += 1
+                if max_updates is not None and seen >= max_updates:
+                    return 0
+    except KeyboardInterrupt:
+        return 0
+    except OSError as e:
+        if seen == 0:
+            print(f"Error: stream failed: {e}", file=sys.stderr)
+            return 2
+    return 0

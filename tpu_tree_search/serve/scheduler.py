@@ -1,0 +1,281 @@
+"""The serve scheduler: worker threads + checkpoint-based preemption.
+
+One job runs as a sequence of **slices**. Each slice is one
+``resident_search``/``mesh_resident_search`` call whose ``yield_fn``
+(checked by ``RunController`` at every dispatch boundary) cuts the run
+when the job is cancelled, the daemon is draining, or the job's time
+quantum expired while other work waits. A cut drains the dispatch queue,
+snapshots the frontier, and writes the job's checkpoint — the next slice
+resumes from it and the final counters are full-run totals, bit-identical
+to an uninterrupted run (engine/checkpoint.py's contract, proved by
+tests/test_checkpoint.py and re-proved for serve in tests/test_serve.py).
+
+Env pins: trace-time routing reads process env (``routing_cache_token``),
+so two jobs pinning DIFFERENT knob values must not trace concurrently.
+``EnvLease`` is a refcounted knob lease — jobs with identical pin dicts
+share it (full concurrency), a job with different pins waits for the
+current holders to finish their slices. With the default single worker
+the lease never blocks; it exists so ``--workers N`` stays correct.
+
+Lock order (analysis/lockorder.py audits this): no scheduler method holds
+two of {Scheduler._cv, EnvLease._cv, JobRegistry._lock} at once — every
+cross-class call happens outside the local ``with`` block.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from . import pool as pool_mod
+from .jobs import result_record
+
+
+class EnvLease:
+    """Refcounted process-env pin lease. ``acquire(pins)`` blocks until
+    the current pin set is empty or equal, then applies the pins (saving
+    prior values); the last ``release`` restores them. Methods never hold
+    any other lock while waiting."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._pins = None  # guarded-by: _cv
+        self._count = 0  # guarded-by: _cv
+        self._saved = {}  # guarded-by: _cv
+
+    def acquire(self, pins: dict) -> None:
+        pins = dict(pins)
+        with self._cv:
+            while self._count and self._pins != pins:
+                self._cv.wait(0.2)
+            if self._count == 0:
+                self._pins = pins
+                self._saved = {k: os.environ.get(k) for k in pins}
+                os.environ.update(pins)
+            self._count += 1
+
+    def release(self) -> None:
+        with self._cv:
+            self._count -= 1
+            if self._count == 0:
+                for k, v in self._saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                self._pins = None
+                self._saved = {}
+                self._cv.notify_all()
+
+
+class Scheduler:
+    """FIFO queue + N worker threads (default 1: one accelerator, one
+    resident loop at a time — more workers only help when jobs share pins
+    and the backend multiplexes)."""
+
+    def __init__(self, registry, pool, workers: int = 1,
+                 quantum_s: float = 5.0, state_dir: str = "."):
+        self.registry = registry
+        self.pool = pool
+        self.workers = max(1, int(workers))
+        self.quantum_s = float(quantum_s)
+        self.state_dir = state_dir
+        self.lease = EnvLease()
+        self._cv = threading.Condition()
+        self._queue = deque()  # guarded-by: _cv  (job ids)
+        self._stopping = False  # guarded-by: _cv
+        self._active = 0  # guarded-by: _cv  (jobs inside a slice)
+        self._threads = []
+
+    # -- queue side (HTTP thread + workers) --------------------------------
+
+    def start(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 name=f"tts-serve-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def submit(self, job) -> int:
+        """Enqueue an admitted job; returns its queue position."""
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("scheduler is draining")
+            self._queue.append(job.id)
+            pos = len(self._queue)
+            self._cv.notify()
+        return pos
+
+    def cancel(self, job) -> bool:
+        """Cancel: drop a queued job immediately; flag a running one (its
+        yield_fn cuts at the next dispatch boundary). Returns False when
+        the job already finished."""
+        with self._cv:
+            queued = job.id in self._queue
+            if queued:
+                self._queue.remove(job.id)
+        if queued:
+            self.registry.transition(job, "cancelled")
+            return True
+        if job.state == "running":
+            job.cancel_requested = True
+            return True
+        if job.state in ("queued", "requeued"):
+            # Raced off the queue or loaded-requeued: mark directly.
+            self.registry.transition(job, "cancelled")
+            return True
+        return False
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def _waiters(self) -> bool:
+        with self._cv:
+            return self._stopping or len(self._queue) > 0
+
+    def _stop_requested(self) -> bool:
+        with self._cv:
+            return self._stopping
+
+    def drain(self, timeout_s: float = 120.0) -> None:
+        """Graceful stop: reject new work, cut running slices at the next
+        dispatch boundary (checkpointed), mark everything still pending as
+        ``requeued`` (a restarted daemon re-admits it), wait for workers
+        to go idle."""
+        with self._cv:
+            self._stopping = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        for jid in pending:
+            job = self.registry.get(jid)
+            if job is not None and job.state == "queued":
+                self.registry.transition(job, "requeued")
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._cv:
+                if self._active == 0:
+                    return
+            time.sleep(0.05)
+
+    # -- worker side -------------------------------------------------------
+
+    def _worker(self, wid: int) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopping:
+                    self._cv.wait(0.5)
+                if self._stopping and not self._queue:
+                    return
+                jid = self._queue.popleft()
+                self._active += 1
+            try:
+                job = self.registry.get(jid)
+                if job is not None and job.state in ("queued", "requeued"):
+                    self._run_slice(job, wid)
+            finally:
+                with self._cv:
+                    self._active -= 1
+                    self._cv.notify_all()
+
+    def _checkpoint_path(self, job) -> str:
+        return os.path.join(self.state_dir, "jobs", f"{job.id}.ckpt.npz")
+
+    def _run_slice(self, job, wid: int) -> None:
+        from ..obs import flightrec
+
+        entry = self.pool.admit(job.spec)
+        problem = entry.problem
+        prog0, step0 = pool_mod.compile_stats(problem)
+        self.registry.transition(job, "running", slices=job.slices + 1)
+        if job.recorder is None:
+            # Private ring per job: never installs process-wide handlers;
+            # always_on makes it record without TTS_OBS.
+            # Finer snapshot cadence than the global ring: a tenant
+            # watching one short job wants more than one frame.
+            job.recorder = flightrec.FlightRecorder(
+                always_on=True, snapshot_period_us=50_000.0
+            )
+            with job.recorder._lock:
+                job.recorder._meta.update(job=job.id, cls=job.class_key)
+        ckpt = self._checkpoint_path(job)
+        quantum = self.quantum_s
+        t0 = time.monotonic()
+
+        def yield_fn() -> bool:
+            if job.cancel_requested or self._stop_requested():
+                return True
+            return (time.monotonic() - t0 >= quantum) and self._waiters()
+
+        kw = dict(
+            m=job.spec["m"], M=job.spec["M"],
+            max_steps=job.spec.get("max_steps"),
+            checkpoint_path=ckpt,
+            checkpoint_interval_s=1e9,  # cut-only: no periodic snapshots
+            resume_from=job.checkpoint,
+            yield_fn=yield_fn,
+        )
+        if job.spec.get("K") is not None:
+            kw["K"] = job.spec["K"]
+        self.lease.acquire(job.pins)
+        try:
+            with flightrec.bound(job.recorder):
+                if job.spec["tier"] == "mesh":
+                    from ..parallel.resident_mesh import mesh_resident_search
+
+                    res = mesh_resident_search(
+                        problem, D=job.spec.get("D"),
+                        mp=job.spec.get("mp", 1), **kw,
+                    )
+                else:
+                    from ..engine.resident import resident_search
+
+                    res = resident_search(problem, **kw)
+        except Exception as e:  # noqa: BLE001 — a job must not kill its worker
+            self.registry.transition(job, "failed", error=f"{type(e).__name__}: {e}")
+            return
+        finally:
+            self.lease.release()
+        prog1, step1 = pool_mod.compile_stats(problem)
+        self.registry.update(
+            job,
+            new_programs=job.new_programs + (prog1 - prog0),
+            new_step_compiles=job.new_step_compiles + (step1 - step0),
+        )
+        self.pool.mark_warm(entry)
+        if res.complete or job.spec.get("max_steps") is not None:
+            # Done (a max_steps job "completes" at its cutoff by design).
+            self.registry.transition(job, "done", result=result_record(res))
+            for p in (ckpt, job.checkpoint):
+                if p and os.path.exists(p):
+                    os.remove(p)
+            self.registry.update(job, checkpoint=None)
+            return
+        has_ckpt = os.path.exists(ckpt)
+        if job.cancel_requested:
+            self.registry.transition(
+                job, "cancelled",
+                checkpoint=ckpt if has_ckpt else job.checkpoint,
+                result=result_record(res),
+            )
+            return
+        if self._stop_requested():
+            # Daemon drain: preserve the cut for the next daemon.
+            self.registry.transition(
+                job, "requeued",
+                checkpoint=ckpt if has_ckpt else job.checkpoint,
+            )
+            return
+        # Quantum preemption: back of the queue, resume from the cut.
+        self.registry.update(
+            job, preemptions=job.preemptions + 1,
+            checkpoint=ckpt if has_ckpt else job.checkpoint,
+        )
+        self.registry.transition(job, "queued")
+        try:
+            self.submit(job)
+        except RuntimeError:
+            self.registry.transition(job, "requeued")

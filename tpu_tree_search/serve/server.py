@@ -1,0 +1,320 @@
+"""The ``tts serve`` daemon: localhost HTTP/JSON + per-job SSE.
+
+Zero-dependency by the same rule as ``obs/live.py`` (stdlib
+``http.server`` only, bound to 127.0.0.1 — an operator-side service, not
+an internet surface). The HTTP threads only touch the registry, the
+scheduler queue, and the pool's bookkeeping; jax lives entirely in the
+scheduler workers.
+
+API (all JSON):
+
+  * ``POST /submit``             — body: a job spec (serve/jobs.py).
+    201 -> ``{id, class, warm, position}``; 400 invalid spec; 503 when
+    the queue is at ``--max-queue`` (admission control back-pressure).
+  * ``GET  /jobs``               — every job record, id-ordered.
+  * ``GET  /job/<id>``           — one job record (404 unknown).
+  * ``GET  /job/<id>/result``    — the result record; 409 until the job
+    reaches a terminal state (a blocking client polls or streams).
+  * ``POST /job/<id>/cancel``    — cancel queued now / running at the
+    next dispatch boundary; 409 when already finished.
+  * ``GET  /job/<id>/stream``    — SSE: one frame per new snapshot from
+    the job's private flight-recorder ring (incumbent, nodes/s, pool
+    occupancy ...), closed by an ``event: done`` frame carrying the
+    final job record — one connection is the whole job story.
+  * ``GET  /classes``            — program-pool stats per shape class.
+  * ``GET  /healthz``            — liveness + queue depth.
+  * ``POST /shutdown``           — graceful drain (same path as SIGTERM).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from ..obs.live import sse_begin, stream_snapshots
+from . import DEFAULT_PORT
+from .jobs import JobRegistry, validate_spec
+from .pool import ProgramPool
+from .scheduler import Scheduler
+
+#: Jobs in a terminal state (no further transitions).
+FINAL_STATES = ("done", "failed", "cancelled")
+
+
+def default_state_dir() -> str:
+    return os.environ.get("TTS_SERVE_STATE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "tpu_tree_search", "serve"
+    )
+
+
+class ServeDaemon:
+    """The daemon's spine: registry + pool + scheduler + HTTP server."""
+
+    def __init__(self, port: int = DEFAULT_PORT, host: str = "127.0.0.1",
+                 state_dir: str | None = None, workers: int = 1,
+                 quantum_s: float = 5.0, max_queue: int = 64):
+        self.state_dir = state_dir or default_state_dir()
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.registry = JobRegistry(self.state_dir)
+        self.loaded = self.registry.load()
+        self.pool = ProgramPool()
+        self.scheduler = Scheduler(self.registry, self.pool, workers=workers,
+                                   quantum_s=quantum_s,
+                                   state_dir=self.state_dir)
+        self.max_queue = max_queue
+        self.stop_event = threading.Event()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.daemon = self  # handler back-reference
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._http_thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self.scheduler.start()
+        # Jobs interrupted by a previous daemon come back requeued with
+        # their checkpoints: re-admit them in id order before new work.
+        for job in self.registry.all():
+            if job.state == "requeued":
+                self.registry.transition(job, "queued")
+                self.scheduler.submit(job)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="tts-serve-http", daemon=True,
+        )
+        self._http_thread.start()
+
+    def submit(self, spec) -> tuple[dict, int]:
+        """Admission: validate -> classify -> enqueue. Returns (payload,
+        http status). Runs in HTTP threads — no jax, no problem builds."""
+        try:
+            spec = validate_spec(spec)
+        except ValueError as e:
+            return {"error": str(e)}, 400
+        if self.scheduler.queue_depth() >= self.max_queue:
+            return {"error": f"queue full ({self.max_queue})"}, 503
+        cls = self.pool.peek(spec)
+        from .jobs import job_pins
+
+        job = self.registry.create(spec, cls["class"], job_pins(spec),
+                                   warm_hit=cls["warm"])
+        try:
+            pos = self.scheduler.submit(job)
+        except RuntimeError:
+            self.registry.transition(job, "requeued")
+            return {"error": "daemon is draining"}, 503
+        return {"id": job.id, "class": cls["class"], "warm": cls["warm"],
+                "position": pos}, 201
+
+    def shutdown(self) -> None:
+        """Graceful drain; idempotent (SIGTERM and POST /shutdown share
+        it). Runs the scheduler drain in the caller's thread, then wakes
+        the main loop."""
+        self.scheduler.drain()
+        self.stop_event.set()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tts-serve/1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+    @property
+    def daemon(self) -> ServeDaemon:
+        return self.server.daemon
+
+    def _json(self, payload, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        if n <= 0 or n > (1 << 20):
+            return None
+        try:
+            return json.loads(self.rfile.read(n).decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def _job(self, jid: str):
+        return self.daemon.registry.get(jid)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
+        path = urlparse(self.path).path
+        try:
+            if path == "/jobs":
+                self._json([j.record() for j in self.daemon.registry.all()])
+            elif path == "/classes":
+                self._json(self.daemon.pool.stats())
+            elif path == "/healthz":
+                self._json({
+                    "ok": True,
+                    "queue_depth": self.daemon.scheduler.queue_depth(),
+                    "jobs": len(self.daemon.registry.all()),
+                })
+            elif path.startswith("/job/"):
+                parts = path.split("/")  # ['', 'job', '<id>', ...]
+                job = self._job(parts[2]) if len(parts) >= 3 else None
+                if job is None:
+                    self._json({"error": "unknown job"}, code=404)
+                elif len(parts) == 3:
+                    self._json(job.record())
+                elif parts[3] == "result":
+                    if job.state in FINAL_STATES:
+                        self._json({"id": job.id, "state": job.state,
+                                    "result": job.result,
+                                    "error": job.error})
+                    else:
+                        self._json({"error": f"job is {job.state}",
+                                    "state": job.state}, code=409)
+                elif parts[3] == "stream":
+                    self._stream_job(job)
+                else:
+                    self._json({"error": "unknown path"}, code=404)
+            else:
+                self._json({"error": "unknown path"}, code=404)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; nothing to clean up
+
+    def do_POST(self):  # noqa: N802
+        path = urlparse(self.path).path
+        try:
+            if path == "/submit":
+                body = self._body()
+                if body is None:
+                    self._json({"error": "invalid JSON body"}, code=400)
+                    return
+                payload, code = self.daemon.submit(body)
+                self._json(payload, code=code)
+            elif path == "/shutdown":
+                self._json({"ok": True, "draining": True})
+                # Drain AFTER replying (it blocks until workers go idle).
+                threading.Thread(target=self.daemon.shutdown,
+                                 name="tts-serve-drain", daemon=True).start()
+            elif path.startswith("/job/") and path.endswith("/cancel"):
+                jid = path.split("/")[2]
+                job = self._job(jid)
+                if job is None:
+                    self._json({"error": "unknown job"}, code=404)
+                elif self.daemon.scheduler.cancel(job):
+                    self._json({"id": job.id, "state": job.state,
+                                "cancelling": True})
+                else:
+                    self._json({"error": f"job already {job.state}"},
+                               code=409)
+            else:
+                self._json({"error": "unknown path"}, code=404)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _stream_job(self, job) -> None:
+        """Per-job SSE: frames from the job's private recorder ring until
+        the job finishes, then the final record as ``event: done``."""
+        daemon = self.daemon
+
+        def latest():
+            rec = job.recorder
+            return rec.latest() if rec is not None else None
+
+        def stop():
+            return (job.state in FINAL_STATES
+                    or daemon.stop_event.is_set()
+                    or getattr(self.server, "closing", False))
+
+        sse_begin(self, comment=f"tts job stream {job.id}")
+        stream_snapshots(
+            self, latest, stop_fn=stop,
+            final_fn=lambda: job.record() if job.state in FINAL_STATES
+            else None,
+        )
+
+
+def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
+               state_dir: str | None = None, workers: int = 1,
+               quantum_s: float = 5.0, max_queue: int = 64,
+               warm: str | None = None) -> int:
+    """The ``tts serve`` entry point: start, optionally pre-warm the pool,
+    then wait for SIGTERM/SIGINT (or POST /shutdown) and drain.
+
+    Signal composition: the daemon's handler is installed FIRST, so a
+    later ``flightrec.install()`` (TTS_FLIGHTREC=1 operators) dumps its
+    post-mortem and then chains to us — one SIGTERM yields both the
+    flight-record dump and a clean drain."""
+    daemon = ServeDaemon(port=port, host=host, state_dir=state_dir,
+                         workers=workers, quantum_s=quantum_s,
+                         max_queue=max_queue)
+
+    def _on_signal(signum, frame):
+        # Handler context: just set the flag; the main loop drains.
+        daemon.stop_event.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
+    from ..obs import flightrec
+
+    if flightrec.enabled():
+        flightrec.recorder().install()  # chains SIGTERM to _on_signal
+    daemon.start()
+    print(f"Serving on {daemon.url} (state: {daemon.state_dir}, "
+          f"workers: {daemon.scheduler.workers}, "
+          f"quantum: {daemon.scheduler.quantum_s:g}s"
+          + (f", reloaded {daemon.loaded} job record(s)" if daemon.loaded
+             else "") + ")", flush=True)
+    if warm is not None:
+        from .warmup import warm_pool
+
+        for line in warm_pool(daemon, warm):
+            print(line, flush=True)
+    try:
+        while not daemon.stop_event.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    print("Draining: cutting running jobs at the next dispatch boundary "
+          "(checkpointed), requeueing pending work...", flush=True)
+    daemon.scheduler.drain()
+    daemon.close()
+    n_requeued = sum(
+        1 for j in daemon.registry.all() if j.state == "requeued"
+    )
+    print(f"Drained ({n_requeued} job(s) requeued for the next daemon).",
+          flush=True)
+    return 0
+
+
+def wait_port(url: str, timeout_s: float = 30.0) -> bool:
+    """Poll ``/healthz`` until the daemon answers (client/test helper)."""
+    from urllib.request import urlopen
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urlopen(url + "/healthz", timeout=2.0) as resp:  # noqa: S310
+                json.loads(resp.read().decode())
+                return True
+        except (OSError, ValueError):
+            time.sleep(0.1)
+    return False
+
+
+if __name__ == "__main__":
+    sys.exit(serve_main())
