@@ -203,6 +203,47 @@ def test_env_lease_serializes_conflicting_pins(monkeypatch):
 # -- registry durability -----------------------------------------------------
 
 
+def test_transition_if_refuses_stale_state(tmp_path):
+    """The CAS transition that keeps a racing cancel and a worker's queue
+    pop coherent: the loser must no-op, never resurrect a terminal job."""
+    reg = JobRegistry(str(tmp_path))
+    job = reg.create(validate_spec(dict(NQ10)), "cls", {})
+    assert reg.transition_if(job, ("queued", "requeued"), "cancelled")
+    # The worker's raced running transition loses and changes nothing.
+    assert not reg.transition_if(job, ("queued", "requeued"), "running")
+    assert job.state == "cancelled"
+    reg2 = JobRegistry(str(tmp_path))
+    reg2.load()
+    assert reg2.get(job.id).state == "cancelled"
+
+
+def test_concurrent_persists_never_tear_the_record(tmp_path):
+    """Concurrent transitions of ONE job (HTTP cancel racing a worker
+    update) must each write through their own tmp file under the io lock —
+    interleaved writes through a shared tmp path used to rename torn JSON
+    into place, which load() then silently dropped."""
+    reg = JobRegistry(str(tmp_path))
+    job = reg.create(validate_spec(dict(NQ10)), "cls", {})
+    stop = threading.Event()
+
+    def hammer(field):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            reg.update(job, **{field: i})
+
+    threads = [threading.Thread(target=hammer, args=(f,), daemon=True)
+               for f in ("preemptions", "slices", "new_programs")]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    reg2 = JobRegistry(str(tmp_path))
+    assert reg2.load() == 1  # the record parses — never a torn write
+
+
 def test_registry_durability_reload(tmp_path):
     reg = JobRegistry(str(tmp_path))
     spec = validate_spec(dict(NQ10))
@@ -353,6 +394,111 @@ def test_preempt_resume_bit_identity(tmp_path):
         assert rec1["result"]["best"] == ref.best
         # Checkpoints are consumed: nothing dangling after completion.
         assert rec1["checkpoint"] is None
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
+def test_max_steps_budget_survives_preemption(tmp_path):
+    """max_steps is a cumulative budget across slices: with quantum=0 and
+    competing work, the job is preempted mid-budget and must resume with
+    the remainder — finishing 'done' only once the whole budget is spent,
+    never at its first cut."""
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"), quantum_s=0.0)
+    d.start()
+    try:
+        base = d.url
+        _, s1 = _post(base, "/submit",
+                      {"problem": "nqueens", "N": 12, "M": 256, "K": 2,
+                       "max_steps": 6})
+        _, s2 = _post(base, "/submit", NQ10)  # the waiter that forces cuts
+        rec1 = _wait_final(base, s1["id"])
+        rec2 = _wait_final(base, s2["id"])
+        assert rec2["state"] == "done", rec2["error"]
+        assert rec1["state"] == "done", rec1["error"]
+        assert rec1["preemptions"] > 0, "quantum=0 with a queue must preempt"
+        # The budget was consumed across slices, exactly — a preemption cut
+        # was not passed off as the max_steps cutoff.
+        assert rec1["steps"] == 6
+        assert rec1["result"]["complete"] is False
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
+def test_cancel_max_steps_job_ends_cancelled(daemon):
+    """A cancelled max_steps job must report 'cancelled' — its yield cut
+    used to be indistinguishable from the max_steps cutoff, recording a
+    silently truncated result as 'done' and deleting the checkpoint."""
+    base = daemon.url
+    _, sub = _post(base, "/submit",
+                   {"problem": "nqueens", "N": 13, "M": 256, "K": 2,
+                    "max_steps": 1 << 20})
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        _, rec = _get(base, f"/job/{sub['id']}")
+        if rec["state"] == "running":
+            break
+        time.sleep(0.05)
+    assert rec["state"] == "running"
+    code, _resp = _post(base, f"/job/{sub['id']}/cancel", {})
+    assert code == 200
+    rec = _wait_final(base, sub["id"])
+    assert rec["state"] == "cancelled"
+    assert rec["steps"] < (1 << 20)
+
+
+def test_drain_requeues_running_max_steps_job(tmp_path):
+    """Daemon drain with a max_steps job in flight: the cut slice must be
+    requeued with its checkpoint (resumable mid-budget), not recorded
+    'done' with partial counters."""
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"))
+    d.start()
+    try:
+        base = d.url
+        _, sub = _post(base, "/submit",
+                       {"problem": "nqueens", "N": 13, "M": 256, "K": 2,
+                        "max_steps": 1 << 20})
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            _, rec = _get(base, f"/job/{sub['id']}")
+            if rec["state"] == "running":
+                break
+            time.sleep(0.05)
+        assert rec["state"] == "running"
+        time.sleep(0.5)  # let some dispatches land
+        d.scheduler.drain(timeout_s=60.0)
+        job = d.registry.get(sub["id"])
+        assert job.state == "requeued"
+        assert job.steps < (1 << 20)
+        assert job.checkpoint and os.path.exists(job.checkpoint)
+    finally:
+        d.close()
+
+
+def test_worker_survives_admit_failure(tmp_path):
+    """A per-job failure OUTSIDE the search call (admission, problem
+    construction) must fail the job, not kill the worker — with the
+    default --workers 1 a dead worker leaves a daemon that accepts
+    submits but never runs another job."""
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"))
+    orig_admit = d.pool.admit
+
+    def boom(spec):
+        raise RuntimeError("synthetic admit failure")
+
+    d.pool.admit = boom
+    d.start()
+    try:
+        base = d.url
+        _, sub = _post(base, "/submit", NQ10)
+        rec = _wait_final(base, sub["id"])
+        assert rec["state"] == "failed"
+        assert "synthetic admit failure" in rec["error"]
+        d.pool.admit = orig_admit
+        _, sub2 = _post(base, "/submit", NQ10)
+        rec2 = _wait_final(base, sub2["id"])
+        assert rec2["state"] == "done", rec2["error"]
     finally:
         d.scheduler.drain(timeout_s=30.0)
         d.close()

@@ -935,6 +935,7 @@ def resident_search(
                 phases=phases,
                 diagnostics=diagnostics,
                 complete=False,
+                steps=controller.steps,
                 compact=program.compact,
                 compact_auto=program.compact_auto,
                 pipeline_depth=depth,
@@ -1021,6 +1022,7 @@ def resident_search(
         elapsed=t3 - t0,
         phases=phases,
         diagnostics=diagnostics,
+        steps=controller.steps,
         compact=program.compact,
         compact_auto=program.compact_auto,
         pipeline_depth=depth,

@@ -48,6 +48,11 @@ class SearchResult:
     # False when the run stopped early (max_steps cutoff) and saved a
     # checkpoint instead of finishing; counters cover work done so far.
     complete: bool = True
+    # Resident tiers: dispatch-boundary steps the RunController counted
+    # this run (one per consumed K-cycle dispatch). The serve scheduler
+    # accumulates this across preemption slices so a max_steps budget
+    # spans resumes; 0 for tiers without a controller.
+    steps: int = 0
     # multi/dist tiers: successful intra-host work steals (the reference
     # declares nSteal counters but never reports them,
     # `pfsp_multigpu_chpl.chpl:380`).

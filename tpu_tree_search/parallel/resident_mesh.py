@@ -745,6 +745,7 @@ def mesh_resident_search(
                 diagnostics=diagnostics,
                 per_worker_tree=per_worker.tolist(),
                 complete=False,
+                steps=controller.steps,
                 compact=program.inner.compact,
                 compact_auto=program.inner.compact_auto,
                 pipeline_depth=depth,
@@ -832,6 +833,7 @@ def mesh_resident_search(
         phases=phases,
         diagnostics=diagnostics,
         per_worker_tree=per_worker.tolist(),
+        steps=controller.steps,
         compact=program.inner.compact,
         compact_auto=program.inner.compact_auto,
         pipeline_depth=depth,

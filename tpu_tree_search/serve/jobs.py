@@ -209,6 +209,11 @@ class Job:
         self.finished = None
         self.slices = 0
         self.preemptions = 0
+        # Cumulative RunController dispatch steps across every slice: the
+        # consumed share of the spec's max_steps budget — each slice runs
+        # with the remainder, so a preempted/drained/restarted max_steps
+        # job finishes only when the budget is actually exhausted.
+        self.steps = 0
         self.checkpoint = None  # path; set on first preemption cut
         self.result = None
         self.error = None
@@ -232,6 +237,7 @@ class Job:
             "finished": self.finished,
             "slices": self.slices,
             "preemptions": self.preemptions,
+            "steps": self.steps,
             "checkpoint": self.checkpoint,
             "result": self.result,
             "error": self.error,
@@ -244,8 +250,8 @@ class Job:
     def from_record(cls, rec: dict) -> "Job":
         job = cls(rec["id"], rec["spec"], rec["class"], rec.get("pins", {}))
         for k in ("state", "submitted", "started", "finished", "slices",
-                  "preemptions", "checkpoint", "result", "error", "warm_hit",
-                  "new_programs", "new_step_compiles"):
+                  "preemptions", "steps", "checkpoint", "result", "error",
+                  "warm_hit", "new_programs", "new_step_compiles"):
             if k in rec:
                 setattr(job, k, rec[k])
         return job
@@ -255,13 +261,23 @@ class JobRegistry:
     """Durable id -> Job map. Every mutation goes through a method that
     holds the lock and rewrites the job's file atomically (tmp + rename,
     the checkpoint module's convention) — a crashed daemon loses at most
-    the transition in flight, never a whole record."""
+    the transition in flight, never a whole record.
+
+    Lock order (audited by analysis/lockorder.py): ``_io_lock`` may
+    acquire ``_lock`` (``_persist`` snapshots the record inside its write
+    critical section), never the reverse — every mutator releases
+    ``_lock`` before calling ``_persist``."""
 
     def __init__(self, state_dir: str):
         self.state_dir = state_dir
         self.jobs_dir = os.path.join(state_dir, "jobs")
         os.makedirs(self.jobs_dir, exist_ok=True)
         self._lock = threading.Lock()
+        # Serializes _persist's snapshot+write+rename: concurrent
+        # transitions of one job (HTTP cancel vs worker) must neither
+        # interleave bytes in a shared tmp file nor let an older snapshot's
+        # rename land after a newer one.
+        self._io_lock = threading.Lock()
         self._jobs = {}  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
 
@@ -322,18 +338,44 @@ class JobRegistry:
 
     def transition(self, job: Job, state: str, **fields) -> None:
         assert state in STATES, state
+        self._stamp(job, state, fields)
+        self.update(job, state=state, **fields)
+
+    def transition_if(self, job: Job, from_states, state: str,
+                      **fields) -> bool:
+        """Compare-and-swap transition: applies (and persists) only while
+        the job is still in one of ``from_states``. This is what keeps a
+        racing cancel and a worker's queue pop coherent — whichever CAS
+        wins, the loser no-ops instead of resurrecting a terminal state."""
+        assert state in STATES, state
+        self._stamp(job, state, fields)
+        with self._lock:
+            if job.state not in from_states:
+                return False
+            job.state = state
+            for k, v in fields.items():
+                setattr(job, k, v)
+        self._persist(job)
+        return True
+
+    @staticmethod
+    def _stamp(job: Job, state: str, fields: dict) -> None:
         now = time.time()
         if state == "running" and job.started is None:
             fields.setdefault("started", now)
         if state in ("done", "failed", "cancelled"):
             fields.setdefault("finished", now)
-        self.update(job, state=state, **fields)
 
     def _persist(self, job: Job) -> None:
         path = os.path.join(self.jobs_dir, f"{job.id}.json")
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with self._lock:
-            rec = job.record()
-        with open(tmp, "w") as f:
-            json.dump(rec, f)
-        os.replace(tmp, path)
+        # Thread-unique tmp name AND one writer at a time: snapshotting
+        # under the registry lock inside the io critical section means the
+        # last rename to land is always the newest record — a restart never
+        # loads a torn or stale-ordered file.
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with self._io_lock:
+            with self._lock:
+                rec = job.record()
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, path)

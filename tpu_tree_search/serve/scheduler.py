@@ -18,8 +18,10 @@ current holders to finish their slices. With the default single worker
 the lease never blocks; it exists so ``--workers N`` stays correct.
 
 Lock order (analysis/lockorder.py audits this): no scheduler method holds
-two of {Scheduler._cv, EnvLease._cv, JobRegistry._lock} at once — every
-cross-class call happens outside the local ``with`` block.
+two of {Scheduler._cv, EnvLease._cv, JobRegistry._lock,
+JobRegistry._io_lock} at once — every cross-class call happens outside
+the local ``with`` block. The registry's own ``_io_lock -> _lock``
+nesting (``JobRegistry._persist``) is the graph's only two-lock hold.
 """
 
 from __future__ import annotations
@@ -112,21 +114,19 @@ class Scheduler:
         """Cancel: drop a queued job immediately; flag a running one (its
         yield_fn cuts at the next dispatch boundary). Returns False when
         the job already finished."""
+        # The flag goes first: whatever state the job races into after our
+        # checks, the slice's yield_fn sees it and the post-slice check
+        # records 'cancelled' — an acknowledged cancel can never end 'done'.
+        job.cancel_requested = True
         with self._cv:
-            queued = job.id in self._queue
-            if queued:
+            if job.id in self._queue:
                 self._queue.remove(job.id)
-        if queued:
-            self.registry.transition(job, "cancelled")
+        if self.registry.transition_if(job, ("queued", "requeued"),
+                                       "cancelled"):
             return True
-        if job.state == "running":
-            job.cancel_requested = True
-            return True
-        if job.state in ("queued", "requeued"):
-            # Raced off the queue or loaded-requeued: mark directly.
-            self.registry.transition(job, "cancelled")
-            return True
-        return False
+        # Not queued/requeued: either running (the slice will cut and mark
+        # it cancelled) or already terminal.
+        return job.state == "running"
 
     def queue_depth(self) -> int:
         with self._cv:
@@ -172,10 +172,24 @@ class Scheduler:
                     return
                 jid = self._queue.popleft()
                 self._active += 1
+            job = None
             try:
                 job = self.registry.get(jid)
                 if job is not None and job.state in ("queued", "requeued"):
                     self._run_slice(job, wid)
+            except Exception as e:  # noqa: BLE001 — a worker must outlive
+                # ANY per-job failure (admission, knob resolution, registry
+                # persistence, recorder setup — not just the search call):
+                # with the default --workers 1 a dead worker leaves a
+                # daemon that accepts submits but never runs another job.
+                try:
+                    if job is not None:
+                        self.registry.transition_if(
+                            job, ("queued", "requeued", "running"), "failed",
+                            error=f"{type(e).__name__}: {e}",
+                        )
+                except Exception:  # noqa: BLE001 — even the failed
+                    pass  # transition failing (disk full) must not kill us
             finally:
                 with self._cv:
                     self._active -= 1
@@ -187,10 +201,18 @@ class Scheduler:
     def _run_slice(self, job, wid: int) -> None:
         from ..obs import flightrec
 
+        if job.cancel_requested:
+            # Cancel raced the job off the queue: honour it before spending
+            # any admission work.
+            self.registry.transition_if(job, ("queued", "requeued"),
+                                        "cancelled")
+            return
         entry = self.pool.admit(job.spec)
         problem = entry.problem
         prog0, step0 = pool_mod.compile_stats(problem)
-        self.registry.transition(job, "running", slices=job.slices + 1)
+        if not self.registry.transition_if(job, ("queued", "requeued"),
+                                           "running", slices=job.slices + 1):
+            return  # a racing cancel won; never flip a terminal state back
         if job.recorder is None:
             # Private ring per job: never installs process-wide handlers;
             # always_on makes it record without TTS_OBS.
@@ -203,16 +225,20 @@ class Scheduler:
                 job.recorder._meta.update(job=job.id, cls=job.class_key)
         ckpt = self._checkpoint_path(job)
         quantum = self.quantum_s
-        t0 = time.monotonic()
+        t0 = time.monotonic()  # restarted below, once the env lease is held
 
         def yield_fn() -> bool:
             if job.cancel_requested or self._stop_requested():
                 return True
             return (time.monotonic() - t0 >= quantum) and self._waiters()
 
+        budget = job.spec.get("max_steps")
         kw = dict(
             m=job.spec["m"], M=job.spec["M"],
-            max_steps=job.spec.get("max_steps"),
+            # The spec's max_steps is a CUMULATIVE budget: each slice runs
+            # with whatever the previous slices left over, so a preempted
+            # or drained job resumes mid-budget instead of restarting it.
+            max_steps=None if budget is None else budget - job.steps,
             checkpoint_path=ckpt,
             checkpoint_interval_s=1e9,  # cut-only: no periodic snapshots
             resume_from=job.checkpoint,
@@ -221,6 +247,11 @@ class Scheduler:
         if job.spec.get("K") is not None:
             kw["K"] = job.spec["K"]
         self.lease.acquire(job.pins)
+        # Quantum clock starts AFTER the lease: time blocked waiting for a
+        # conflicting env pin is queueing, not run time — charging it would
+        # preempt a contended pinned job at its first dispatch boundary
+        # every slice.
+        t0 = time.monotonic()
         try:
             with flightrec.bound(job.recorder):
                 if job.spec["tier"] == "mesh":
@@ -242,12 +273,18 @@ class Scheduler:
         prog1, step1 = pool_mod.compile_stats(problem)
         self.registry.update(
             job,
+            steps=job.steps + res.steps,
             new_programs=job.new_programs + (prog1 - prog0),
             new_step_compiles=job.new_step_compiles + (step1 - step0),
         )
         self.pool.mark_warm(entry)
-        if res.complete or job.spec.get("max_steps") is not None:
-            # Done (a max_steps job "completes" at its cutoff by design).
+        if res.complete or (budget is not None and job.steps >= budget):
+            # Done: the search finished, or the cumulative max_steps budget
+            # is exhausted (a max_steps job "completes" at its cutoff by
+            # design). A yield cut — cancel, drain, quantum preemption —
+            # always leaves the budget unexhausted (the max_steps cutoff
+            # wins the same dispatch boundary), so it can never be
+            # mistaken for the cutoff and silently truncate a result.
             self.registry.transition(job, "done", result=result_record(res))
             for p in (ckpt, job.checkpoint):
                 if p and os.path.exists(p):
