@@ -1,0 +1,238 @@
+"""Search-quality telemetry (obs/quality.py + problems/taillard_optima.py):
+the incumbent trajectory, primal gap/integral math, the committed
+best-known table, engine wiring, and the quality-off byte-identity
+contract.
+
+Everything runs on the virtual CPU platform with small shapes; the
+identity claims are the same registry entries `tts check` audits over
+the full knob matrix.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_tree_search.obs import quality
+from tpu_tree_search.problems import taillard_optima
+from tpu_tree_search.problems.base import INF_BOUND
+from tpu_tree_search.problems.nqueens import NQueensProblem
+
+
+# -- the committed best-known table -----------------------------------------
+
+
+def test_every_bundled_instance_has_an_entry():
+    # The generator covers ta001..ta120; the reference table must too —
+    # a gap here silently turns a quality curve's gap column into "?".
+    for inst in range(1, 121):
+        v = taillard_optima.known_optimum(inst)
+        assert isinstance(v, int) and v > 0, f"ta{inst:03d} missing"
+
+
+def test_table_spot_values_and_provenance_consistency():
+    # Spot values from Taillard's published tables.
+    assert taillard_optima.known_optimum(1) == 1278
+    assert taillard_optima.known_optimum(14) == 1377
+    assert taillard_optima.known_optimum(21) == 2297
+    assert taillard_optima.known_optimum(120) == 26457
+    # The engine's initial-UB table (pfsp/taillard.py, from c_taillard.c)
+    # must agree entry-for-entry: both derive from the same source, and a
+    # drift between them would mean gaps measured against a moving UB.
+    from tpu_tree_search.problems.pfsp import taillard
+
+    for inst in range(1, 121):
+        assert (taillard_optima.known_optimum(inst)
+                == taillard.OPTIMAL_MAKESPANS[inst - 1]), inst
+
+
+def test_unknown_instances_are_none_not_errors():
+    assert taillard_optima.known_optimum(0) is None
+    assert taillard_optima.known_optimum(121) is None
+    assert taillard_optima.known_optimum("ta014") is None
+    assert taillard_optima.known_optimum(None) is None
+
+
+def test_optimum_for_problem_objects():
+    class FakePfsp:
+        name = "pfsp"
+        inst = 14
+
+    class FakeOther:
+        name = "nqueens"
+
+    assert taillard_optima.optimum_for(FakePfsp()) == 1377
+    assert taillard_optima.optimum_for(FakeOther()) is None
+    assert taillard_optima.optimum_for(None) is None
+
+
+def test_gap_semantics():
+    assert taillard_optima.gap(1377, 1377) == 0.0
+    assert taillard_optima.gap(1515, 1377) == pytest.approx(138 / 1377)
+    # Cleanly None on every unknown: no incumbent yet, no reference, or
+    # a nonsense reference.
+    assert taillard_optima.gap(None, 1377) is None
+    assert taillard_optima.gap(INF_BOUND, 1377) is None
+    assert taillard_optima.gap(1500, None) is None
+    assert taillard_optima.gap(1500, 0) is None
+
+
+# -- recorder semantics ------------------------------------------------------
+
+
+def test_recorder_first_observation_always_records():
+    rec = quality.QualityRecorder()
+    assert rec.observe(INF_BOUND, 1, 100)  # anchors the curve
+    assert not rec.observe(INF_BOUND, 2, 200)  # no improvement
+    assert rec.observe(50, 3, 300)
+    assert not rec.observe(50, 4, 400)
+    pts = rec.points()
+    assert [p["best"] for p in pts] == [INF_BOUND, 50]
+    assert pts[0]["t_s"] == 0.0  # time base = first observation
+
+
+def test_recorder_step_offset_spans_slices():
+    # The serve scheduler sets step_offset to the job's cumulative steps
+    # before each slice, so recorded steps stay job-cumulative.
+    rec = quality.QualityRecorder()
+    rec.observe(100, 5, 10)
+    rec.step_offset = 40
+    rec.observe(90, 5, 20)  # slice-local step 5 == job step 45
+    assert [p["step"] for p in rec.points()] == [5, 45]
+
+
+def test_recorder_result_payload():
+    rec = quality.QualityRecorder(optimum=1377)
+    rec.observe(1500, 1, 10)
+    out = rec.result()
+    assert out["optimum"] == 1377
+    assert out["points"][0]["best"] == 1500
+    json.dumps(out)  # the payload must be JSON-serializable as-is
+
+
+# -- tracker arming ----------------------------------------------------------
+
+
+def test_tracker_off_by_default(monkeypatch):
+    monkeypatch.delenv("TTS_QUALITY", raising=False)
+    assert quality.tracker() is None
+
+
+def test_tracker_armed_by_knob(monkeypatch):
+    monkeypatch.setenv("TTS_QUALITY", "1")
+    rec = quality.tracker()
+    assert isinstance(rec, quality.QualityRecorder)
+
+
+def test_tracker_bound_recorder_wins_and_resolves_optimum(monkeypatch):
+    monkeypatch.delenv("TTS_QUALITY", raising=False)
+
+    class FakePfsp:
+        name = "pfsp"
+        inst = 14
+
+    mine = quality.QualityRecorder()
+    with quality.bound(mine):
+        got = quality.tracker(FakePfsp())
+        assert got is mine and got.optimum == 1377
+    assert quality.tracker(FakePfsp()) is None  # binding restored
+
+
+# -- primal integral ---------------------------------------------------------
+
+
+def test_primal_integral_step_function():
+    # Optimal found at t=0.5 of a 1s horizon: gap is cap (1.0) for the
+    # first half, 0 after -> integral 0.5.
+    pts = [{"t_s": 0.5, "best": 100}]
+    assert quality.primal_integral(pts, 100, 1.0) == pytest.approx(0.5)
+    # Never found anything: flat at cap.
+    assert quality.primal_integral([], 100, 1.0) == pytest.approx(1.0)
+    # 10% gap from t=0: flat at 0.1.
+    pts = [{"t_s": 0.0, "best": 110}]
+    assert quality.primal_integral(pts, 100, 2.0) == pytest.approx(0.1)
+    # Two-step descent.
+    pts = [{"t_s": 0.0, "best": 150}, {"t_s": 1.0, "best": 100}]
+    assert quality.primal_integral(pts, 100, 2.0) == pytest.approx(0.25)
+
+
+def test_primal_integral_unknowns_and_caps():
+    assert quality.primal_integral([], None, 1.0) is None
+    assert quality.primal_integral([], 100, 0.0) is None
+    # An INF incumbent (N-Queens sentinel) counts as cap, not a crash.
+    pts = [{"t_s": 0.0, "best": INF_BOUND}]
+    assert quality.primal_integral(pts, 100, 1.0) == pytest.approx(1.0)
+    # Gaps above cap clamp to cap.
+    pts = [{"t_s": 0.0, "best": 1000}]
+    assert quality.primal_integral(pts, 100, 1.0) == pytest.approx(1.0)
+
+
+# -- engine wiring -----------------------------------------------------------
+
+
+def test_resident_quality_trajectory_and_bit_identity(monkeypatch):
+    from tpu_tree_search.engine.resident import resident_search
+
+    monkeypatch.delenv("TTS_QUALITY", raising=False)
+    off = resident_search(NQueensProblem(N=8), m=5, M=64)
+    assert off.quality is None  # off by default — nothing recorded
+    monkeypatch.setenv("TTS_QUALITY", "1")
+    on = resident_search(NQueensProblem(N=8), m=5, M=64)
+    # Telemetry must not perturb the search: same totals, same result.
+    assert (on.explored_tree, on.explored_sol, on.best) == (
+        off.explored_tree, off.explored_sol, off.best)
+    assert on.quality is not None and on.quality["points"]
+    p0 = on.quality["points"][0]
+    assert p0["best"] == INF_BOUND  # N-Queens has no objective
+    assert p0["nodes"] > 0 and p0["t_s"] == 0.0
+
+
+@pytest.mark.slow  # pfsp resident compile dominates; CI runs it unfiltered
+def test_pfsp_quality_curve_has_gap(monkeypatch):
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import PFSPProblem
+
+    monkeypatch.setenv("TTS_QUALITY", "1")
+    problem = PFSPProblem(inst=14, lb="lb1", ub=1)
+    res = resident_search(problem, m=5, M=512, max_steps=30)
+    q = res.quality
+    assert q is not None and q["optimum"] == 1377
+    assert q["points"], "warm-start UB should anchor the curve"
+    # ub=1 starts from the optimal table value -> gap 0 at the anchor.
+    g = quality.primal_gap(q["points"][0]["best"], q["optimum"])
+    assert g == pytest.approx(0.0)
+    pi = quality.primal_integral(q["points"], q["optimum"],
+                                 max(res.elapsed, 1e-9))
+    assert pi is not None and 0.0 <= pi <= 1.0
+
+
+@pytest.mark.slow  # mesh compile; CI runs it unfiltered
+def test_mesh_quality_trajectory(monkeypatch):
+    from tpu_tree_search.parallel.resident_mesh import mesh_resident_search
+
+    monkeypatch.setenv("TTS_QUALITY", "1")
+    res = mesh_resident_search(NQueensProblem(N=8), m=5, M=64)
+    assert res.quality is not None and res.quality["points"]
+
+
+# -- the compiled-program contract ------------------------------------------
+
+
+def test_quality_off_identity_contract():
+    from tpu_tree_search.analysis import contracts, program_audit
+
+    program_audit.load_contracts()
+    art = program_audit.variant_artifact(
+        "nqueens", labels=["off", "quality1"]
+    )
+    # Host-side-only telemetry: the TTS_QUALITY=1 step jaxpr is byte-
+    # identical to the off build (same text, same outvar count).
+    assert contracts.run_one("quality-off-identity", art) == []
+
+
+def test_quality_knob_in_audit_matrix():
+    from tpu_tree_search.analysis import program_audit
+
+    assert "TTS_QUALITY" in program_audit.KNOBS
+    assert program_audit.VARIANT_ENVS["quality1"] == {"TTS_QUALITY": "1"}

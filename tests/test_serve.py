@@ -281,7 +281,7 @@ def test_e2e_submit_stream_result_bit_identical_to_cli(daemon, capsys):
     # Stream the job: snapshot frames then the final record as `done`.
     from tpu_tree_search.obs.live import iter_sse
 
-    frames, final = [], None
+    frames, incumbents, final = [], [], None
     with urllib.request.urlopen(
         base + f"/job/{sub['id']}/stream", timeout=180
     ) as resp:
@@ -289,10 +289,14 @@ def test_e2e_submit_stream_result_bit_identical_to_cli(daemon, capsys):
             if event == "done":
                 final = payload
                 break
+            if event == "incumbent":  # quality frames ride the same stream
+                incumbents.append(payload)
+                continue
             frames.append(payload)
     assert final is not None and final["state"] == "done"
     assert frames, "expected at least one snapshot frame"
     assert frames[-1]["tier"] == "resident"
+    assert incumbents and incumbents[0]["job"] == sub["id"]
     assert final["result"]["explored_tree"] == cli_rec["explored_tree"]
     assert final["result"]["explored_sol"] == cli_rec["explored_sol"]
     # /result agrees with the stream's terminal frame.

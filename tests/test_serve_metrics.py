@@ -1,0 +1,463 @@
+"""Daemon observability (serve/metrics.py + the fleet-telemetry legs):
+/metrics exposition correctness vs the live registry, /healthz fields,
+SSE incumbent/done ordering and the done-vs-cancel race, the follow_job
+reconnect dedupe, `tts top`, and per-job report lanes.
+
+Everything runs on the virtual CPU platform with small shapes; daemons
+are in-process on port 0.  Several tests use an HTTP-thread-only daemon
+(scheduler never started) so queued-state behavior is deterministic —
+same idiom as test_serve.test_queue_admission_control.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_tree_search.serve import VERSION
+from tpu_tree_search.serve import metrics as serve_metrics
+from tpu_tree_search.serve.server import ServeDaemon
+
+_FINAL = ("done", "failed", "cancelled")
+
+# Same shared small shape as test_serve: each daemon compiles it once.
+NQ10 = {"problem": "nqueens", "N": 10, "M": 256}
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _scrape(base):
+    """GET /metrics; assert the content type and that every sample line
+    parses. Returns ``{name: {labels-tuple: value}}``."""
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"] == serve_metrics.CONTENT_TYPE
+        text = r.read().decode()
+    return serve_metrics.parse_text(text)
+
+
+def _wait_final(base, jid, timeout_s=180.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        code, rec = _get(base, f"/job/{jid}")
+        assert code == 200, rec
+        if rec["state"] in _FINAL:
+            return rec
+        time.sleep(0.1)
+    raise AssertionError(f"job {jid} did not finish in {timeout_s}s")
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"))
+    d.start()
+    yield d
+    d.scheduler.drain(timeout_s=30.0)
+    d.close()
+
+
+@pytest.fixture
+def idle_daemon(tmp_path):
+    """HTTP endpoints up, scheduler NOT started: submitted jobs stay
+    queued forever, so queued-state HTTP behavior is deterministic."""
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"))
+    d._http_thread = threading.Thread(
+        target=d._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+        daemon=True)
+    d._http_thread.start()
+    yield d
+    d.close()
+
+
+# -- exposition format: render + parse ---------------------------------------
+
+
+def test_histogram_buckets_are_cumulative(tmp_path):
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "s"))
+    try:
+        for v in (0.001, 0.3, 400.0):  # first bucket, mid bucket, overflow
+            d.metrics.observe("tts_serve_run_seconds", v, {"cls": "c"})
+        parsed = serve_metrics.parse_text(serve_metrics.render(d))
+        b = parsed["tts_serve_run_seconds_bucket"]
+        assert b[(("cls", "c"), ("le", "0.005"))] == 1
+        assert b[(("cls", "c"), ("le", "0.5"))] == 2
+        assert b[(("cls", "c"), ("le", "300.0"))] == 2  # 400 is past the top
+        assert b[(("cls", "c"), ("le", "+Inf"))] == 3
+        assert parsed["tts_serve_run_seconds_count"][(("cls", "c"),)] == 3
+        assert parsed["tts_serve_run_seconds_sum"][
+            (("cls", "c"),)] == pytest.approx(400.301)
+    finally:
+        # close() drains serve_forever, which never ran here.
+        d._httpd.server_close()
+
+
+def test_label_escaping_roundtrip_and_malformed_rejection(tmp_path):
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "s"))
+    try:
+        weird = 'a"b\\c\nd'
+        d.metrics.inc("tts_serve_admissions_total", {"outcome": weird})
+        parsed = serve_metrics.parse_text(serve_metrics.render(d))
+        assert parsed["tts_serve_admissions_total"][
+            (("outcome", weird),)] == 1
+        # build_info carries the version label; value is always 1.
+        assert parsed["tts_serve_build_info"][(("version", VERSION),)] == 1
+    finally:
+        d._httpd.server_close()
+    with pytest.raises(ValueError):
+        serve_metrics.parse_text("this is not a metric line\n")
+
+
+# -- /healthz ----------------------------------------------------------------
+
+
+def test_healthz_fields_unstarted(idle_daemon):
+    code, h = _get(idle_daemon.url, "/healthz")
+    assert code == 200
+    assert h["version"] == VERSION
+    assert h["uptime_s"] >= 0.0
+    assert h["workers_alive"] == 0
+    assert h["ok"] is True  # scheduler never started: not degraded
+
+
+def test_healthz_fields_started(daemon):
+    code, h = _get(daemon.url, "/healthz")
+    assert code == 200
+    assert h["workers_alive"] >= 1 and h["workers"] >= h["workers_alive"]
+    assert h["ok"] is True
+    # wait_ready returns the same payload (submit uses it for error tags).
+    from tpu_tree_search.serve.server import wait_ready
+
+    got = wait_ready(daemon.url, timeout_s=10.0)
+    assert got is not None and got["version"] == VERSION
+
+
+# -- conflict counters (deterministic via the idle daemon) -------------------
+
+
+def test_conflict_counters_by_endpoint(idle_daemon):
+    base = idle_daemon.url
+    code, sub = _post(base, "/submit", NQ10)
+    assert code == 201
+    # /result on a queued job: 409, counted under endpoint="result".
+    assert _get(base, f"/job/{sub['id']}/result")[0] == 409
+    # First cancel lands (queued -> cancelled); the second is a conflict.
+    assert _post(base, f"/job/{sub['id']}/cancel", {})[0] == 200
+    assert _post(base, f"/job/{sub['id']}/cancel", {})[0] == 409
+    parsed = _scrape(base)
+    conflicts = parsed["tts_serve_conflicts_total"]
+    assert conflicts[(("endpoint", "result"),)] == 1
+    assert conflicts[(("endpoint", "cancel"),)] == 1
+    assert parsed["tts_serve_admissions_total"][
+        (("outcome", "admitted"),)] == 1
+    assert parsed["tts_serve_jobs"][(("state", "cancelled"),)] == 1
+
+
+# -- SSE: done vs cancel, both orders ----------------------------------------
+
+
+def test_stream_on_already_cancelled_job_sends_done(idle_daemon):
+    # Order 1: the job reaches its terminal state BEFORE the stream
+    # connects. The stream must immediately close with the final record.
+    from tpu_tree_search.obs.live import iter_sse
+
+    base = idle_daemon.url
+    code, sub = _post(base, "/submit", NQ10)
+    assert code == 201
+    assert _post(base, f"/job/{sub['id']}/cancel", {})[0] == 200
+    final = None
+    with urllib.request.urlopen(
+        base + f"/job/{sub['id']}/stream", timeout=30
+    ) as resp:
+        for event, payload in iter_sse(resp):
+            if event == "done":
+                final = payload
+                break
+    assert final is not None and final["state"] == "cancelled"
+
+
+def test_stream_cancel_midstream_terminates_with_done(daemon):
+    # Order 2: cancel arrives while the stream is live. The stream must
+    # still terminate with a `done` frame carrying a terminal record —
+    # never hang, never close without the terminal frame.
+    from tpu_tree_search.obs.live import iter_sse
+
+    base = daemon.url
+    code, sub = _post(base, "/submit", {**NQ10, "N": 12, "K": 4})
+    assert code == 201
+    final, cancelled = None, False
+    with urllib.request.urlopen(
+        base + f"/job/{sub['id']}/stream", timeout=180
+    ) as resp:
+        for event, payload in iter_sse(resp):
+            if event == "done":
+                final = payload
+                break
+            if not cancelled:
+                cancelled = True
+                _post(base, f"/job/{sub['id']}/cancel", {})
+    # The race is real: the job may finish before the cancel flag is
+    # seen. Either way the stream terminated with a terminal record.
+    assert final is not None and final["state"] in _FINAL
+    code, rec = _get(base, f"/job/{sub['id']}")
+    assert rec["state"] == final["state"]
+
+
+# -- /metrics under load vs the registry (the acceptance check) --------------
+
+
+def test_metrics_scrape_under_load_matches_registry(daemon):
+    base = daemon.url
+    subs = []
+    for n in (9, 10, 10):  # three concurrent jobs across two classes
+        code, sub = _post(base, "/submit",
+                          {"problem": "nqueens", "N": n, "M": 256})
+        assert code == 201
+        subs.append(sub)
+    # Scrape while jobs admit/run/complete: every scrape must parse.
+    scrapes = 0
+    deadline = time.monotonic() + 180.0
+    while time.monotonic() < deadline:
+        parsed = _scrape(base)
+        scrapes += 1
+        done = parsed["tts_serve_jobs"].get((("state", "done"),), 0)
+        if done == len(subs):
+            break
+        time.sleep(0.2)
+    assert scrapes >= 2, "expected scrapes during the run, not just after"
+    for sub in subs:
+        assert _wait_final(base, sub["id"])["state"] == "done"
+
+    parsed = _scrape(base)
+    jobs = daemon.registry.all()
+    # Gauges agree with the registry read the same way an operator would
+    # cross-check them.
+    assert parsed["tts_serve_jobs"][(("state", "done"),)] == len(jobs) == 3
+    assert parsed["tts_serve_admissions_total"][
+        (("outcome", "admitted"),)] == 3
+    classes = {j.class_key for j in jobs}
+    assert len(classes) == 2
+    admitted = parsed["tts_serve_class_jobs_admitted"]
+    assert {(("cls", c),) for c in classes} <= set(admitted)
+    # Flow counters: every job ran >= 1 slice; first-slice queue waits
+    # were observed once per job.
+    slices = parsed["tts_serve_slices_total"]
+    assert sum(slices.values()) >= 3
+    assert {lab[0][1] for lab in slices} == classes
+    qw = parsed["tts_serve_queue_wait_seconds_count"]
+    assert sum(qw.values()) == 3
+    rs = parsed["tts_serve_run_seconds_count"]
+    assert sum(rs.values()) == sum(slices.values())
+    assert parsed["tts_serve_uptime_seconds"][()] > 0
+    assert parsed["tts_serve_queue_depth"][()] == 0
+    _, h = _get(base, "/healthz")
+    assert parsed["tts_serve_workers_alive"][()] == h["workers_alive"]
+    # Per-class compile attribution surfaced as counters: both classes
+    # compiled cold, the warm same-class admission compiled nothing.
+    prog = parsed["tts_serve_new_programs_total"]
+    assert sum(prog.values()) >= 2
+
+
+# -- follow_job reconnect dedupe (the `tts watch --job` reprint bug) ---------
+
+
+def test_follow_job_dedupes_reconnect_replays():
+    # A fake daemon whose stream drops once mid-job: the first connection
+    # replays snapshot A + incumbent n=1 then dies without `done`; the
+    # reconnect replays BOTH again (exactly what the real server does:
+    # per-connection send counters) plus the new n=2 and the terminal
+    # frame. The client must emit each snapshot/incumbent exactly once.
+    from tpu_tree_search.serve.client import follow_job
+
+    snap = {"ts_us": 111, "seq": 1, "step": 1, "tier": "resident"}
+    inc1 = {"t_s": 0.0, "step": 1, "best": 50, "nodes": 4, "n": 1,
+            "job": "j1"}
+    inc2 = {"t_s": 0.5, "step": 2, "best": 40, "nodes": 9, "n": 2,
+            "job": "j1"}
+    final = {"id": "j1", "state": "done", "result": {"best": 40}}
+    streams = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/job/j1/stream":
+                streams.append(1)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                frames = [(None, snap), ("incumbent", inc1)]
+                if len(streams) > 1:  # the reconnect replays + continues
+                    frames += [("incumbent", inc2), ("done", final)]
+                for event, payload in frames:
+                    if event:
+                        self.wfile.write(f"event: {event}\n".encode())
+                    self.wfile.write(
+                        f"data: {json.dumps(payload)}\n\n".encode())
+                # Fall off the end: connection 1 drops without `done`.
+            elif self.path == "/job/j1":
+                body = json.dumps({"id": "j1", "state": "running"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever,
+                         kwargs={"poll_interval": 0.1}, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        snaps, incs = [], []
+        rec = follow_job(base, "j1", emit=snaps.append,
+                         on_incumbent=incs.append, timeout_s=30.0)
+        assert rec == final
+        assert len(streams) >= 2, "test needs an actual reconnect"
+        assert snaps == [snap]  # replayed snapshot emitted once
+        assert [p["n"] for p in incs] == [1, 2]  # n=1 replay suppressed
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# -- `tts top` ---------------------------------------------------------------
+
+
+def test_top_once_smoke(idle_daemon, capsys):
+    from tpu_tree_search import cli
+
+    base = idle_daemon.url
+    assert _post(base, "/submit", NQ10)[0] == 201
+    port = str(idle_daemon.port)
+    assert cli.main(["top", "--port", port, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert f"tts serve v{VERSION}" in out
+    assert "queued=1" in out
+    assert cli.main(["top", "--port", port, "--once", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert payload["health"]["version"] == VERSION
+    assert payload["jobs"][0]["state"] == "queued"
+    assert isinstance(payload["classes"], list)
+
+
+def test_top_unreachable_daemon(capsys):
+    from tpu_tree_search import cli
+
+    assert cli.main(["top", "--port", "1", "--once"]) == 2
+    assert "no serve daemon" in capsys.readouterr().err
+
+
+# -- per-job report lanes + quality table ------------------------------------
+
+
+def test_report_job_lanes_and_quality_sections():
+    # Synthetic merged-daemon trace: two tenants, one with a quality
+    # trajectory against the committed ta014 optimum.
+    from tpu_tree_search.obs import report
+
+    def ev(name, ts, job, args=None, **extra):
+        return {"name": name, "cat": "tts", "ph": "i", "ts": ts,
+                "pid": 0, "tid": 0, "args": args or {}, "job": job,
+                **extra}
+
+    evts = [
+        ev("quality_ref", 0.0, "job-1",
+           {"instance": "ta014", "optimum": 1377}),
+        ev("dispatch", 0.0, "job-1", {"cycles": 100, "tree": 10,
+                                      "best": 1500}, ph="X", dur=1e6),
+        ev("incumbent", 1e6, "job-1", {"best": 1500}),
+        ev("incumbent", 2e6, "job-1", {"best": 1377}),
+        ev("dispatch", 5e5, "job-2", {"cycles": 50, "tree": 5},
+           ph="X", dur=1e6),
+    ]
+    summary = report.summarize(evts)
+    lanes = summary["jobs"]
+    assert set(lanes) == {"job-1", "job-2"}
+    assert lanes["job-1"]["dispatches"] == 1
+    assert lanes["job-1"]["best"] == 1500
+    q = summary["quality"]
+    assert q["instance"] == "ta014" and q["optimum"] == 1377
+    pts = q["jobs"]["job-1"]["points"]
+    assert [p["best"] for p in pts] == [1500, 1377]
+    assert pts[0]["gap"] == pytest.approx(123 / 1377, abs=1e-6)
+    assert q["jobs"]["job-1"]["final_gap"] == 0.0
+    # Span is 2s; gap is capped (1.0) until t=1s, then 123/1377, then 0
+    # at t=2s -> integral (1.0 + 123/1377) / 2.
+    assert q["jobs"]["job-1"]["primal_integral"] == pytest.approx(
+        (1.0 + 123 / 1377) / 2, abs=1e-4)
+    text = report.render(summary)
+    assert "per-job lanes:" in text
+    assert "quality vs time (instance ta014, optimum 1377):" in text
+    assert "final gap 0.00%" in text and "primal integral" in text
+
+
+def test_report_quality_from_daemon_job(daemon, monkeypatch):
+    # End-to-end lane attribution: run one job through the daemon with
+    # host-side event recording armed, watch its stream, then summarize
+    # the drained events. Covers both fleet-telemetry claims at once:
+    # the live stream interleaves incumbent frames before `done` (the
+    # quality anchor guarantees at least one), and the scheduler's
+    # job_context stamps every engine event so the report grows a lane.
+    from tpu_tree_search.obs import events as obs_events
+    from tpu_tree_search.obs import report
+    from tpu_tree_search.obs.live import iter_sse
+
+    monkeypatch.setenv("TTS_OBS", "host")
+    obs_events.reset()
+    base = daemon.url
+    code, sub = _post(base, "/submit", NQ10)
+    assert code == 201
+    order, incumbents, final = [], [], None
+    with urllib.request.urlopen(
+        base + f"/job/{sub['id']}/stream", timeout=180
+    ) as resp:
+        for event, payload in iter_sse(resp):
+            order.append(event or "snapshot")
+            if event == "done":
+                final = payload
+                break
+            if event == "incumbent":
+                incumbents.append(payload)
+    assert final is not None and final["state"] == "done"
+    assert incumbents, "no incumbent frame before job completion"
+    assert order.index("incumbent") < order.index("done")
+    p = incumbents[0]
+    assert p["job"] == sub["id"] and p["n"] == 1
+    assert {"t_s", "step", "best", "nodes"} <= set(p)
+    # Indices are monotone 1-based: the client dedupe key.
+    assert [q["n"] for q in incumbents] == list(
+        range(1, len(incumbents) + 1))
+
+    evts = obs_events.drain()
+    assert evts, "TTS_OBS=host recorded nothing"
+    stamped = [e for e in evts if e.get("job") == sub["id"]]
+    assert stamped, "no events carried the job id"
+    summary = report.summarize(evts)
+    assert sub["id"] in summary["jobs"]
+    assert summary["jobs"][sub["id"]]["dispatches"] >= 1

@@ -54,6 +54,7 @@ KNOBS = (
     "TTS_COMPACT", "TTS_OBS", "TTS_PHASEPROF", "TTS_LB2_PAIRBLOCK",
     "TTS_PIPELINE", "TTS_K", "TTS_GUARD", "TTS_PALLAS", "TTS_PALLAS_LB2",
     "TTS_LB2_STAGED", "TTS_XLA_TRACE", "TTS_FLIGHTREC", "TTS_COSTMODEL",
+    "TTS_QUALITY",
 )
 
 #: Matrix axes (the lb2 families add the pair-block axis).
@@ -69,7 +70,7 @@ def load_contracts() -> dict:
     """Import every contract-declaring module (registration side effects)
     and return the registry."""
     from ..engine import pipeline, resident  # noqa: F401
-    from ..obs import counters, phases  # noqa: F401
+    from ..obs import counters, phases, quality  # noqa: F401
     from ..ops import compaction, pfsp_device  # noqa: F401
     from . import guard, lockorder  # noqa: F401
 
@@ -322,6 +323,7 @@ VARIANT_ENVS = {
     "pipe0": {"TTS_PIPELINE": "0"},
     "pipe2": {"TTS_PIPELINE": "2"},
     "guard1": {"TTS_GUARD": "1"},
+    "quality1": {"TTS_QUALITY": "1"},
 }
 
 

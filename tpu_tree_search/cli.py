@@ -315,6 +315,23 @@ def build_parser() -> argparse.ArgumentParser:
     smt.add_argument("rest", nargs=argparse.REMAINDER,
                      help="a full run command (problem + flags)")
 
+    top = sub.add_parser(
+        "top",
+        help="live per-job / per-class table for a serve daemon "
+        "(assembled from /healthz + /jobs + /classes; the fleet "
+        "operator console — docs/SERVING.md)",
+    )
+    top.add_argument("--port", type=int, default=_SERVE_PORT,
+                     help=f"serve daemon port (default {_SERVE_PORT})")
+    top.add_argument("--host", type=str, default="127.0.0.1")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="refresh period in seconds (default 2)")
+    top.add_argument("--once", action="store_true",
+                     help="print one frame and exit (scripts, CI smoke)")
+    top.add_argument("--json", action="store_true", dest="top_json",
+                     help="emit the composed health/jobs/classes payload "
+                     "as one JSON line per refresh")
+
     wrm = sub.add_parser(
         "warmup",
         help="AOT-compile the validation matrix into the persistent "
@@ -718,6 +735,25 @@ def print_results(args, problem, res) -> None:
             f"host_to_device={d.host_to_device} "
             f"device_to_host={d.device_to_host}{dbuf}"
         )
+    if res.quality and res.quality.get("points"):
+        # TTS_QUALITY=1: the anytime curve (obs/quality.py) — one line per
+        # incumbent improvement, closed with the primal gap when the
+        # instance has a committed reference optimum.
+        from .obs import quality as obs_quality
+
+        q = res.quality
+        opt = q.get("optimum")
+        print(f"Quality trajectory ({len(q['points'])} incumbent(s)"
+              + (f", optimum {opt}" if opt is not None else "") + "):")
+        for p in q["points"]:
+            g = obs_quality.primal_gap(p.get("best"), opt)
+            print(f"  t={p['t_s']:.3f}s  step={p['step']}  "
+                  f"best={p['best']}  nodes={p['nodes']}"
+                  + (f"  gap={100.0 * g:.2f}%" if g is not None else ""))
+        pi = obs_quality.primal_integral(q["points"], opt,
+                                         max(res.elapsed, 1e-9))
+        if pi is not None:
+            print(f"  primal integral: {pi:.4f}")
     if res.steals:
         print(f"Work steals (intra-host): {res.steals}")
     if res.comm:
@@ -749,6 +785,9 @@ def result_record(args, res) -> dict:
         # run's telemetry snapshot like the reference's diagnostics counters
         # ride its .dat lines.
         rec["obs"] = res.obs
+    if res.quality and res.quality.get("points"):
+        # TTS_QUALITY=1: the incumbent trajectory (obs/quality.py).
+        rec["quality"] = res.quality
     if args.problem == "pfsp":
         rec.update(inst=args.inst, lb=args.lb, ub=args.ub, optimum=res.best)
     else:
@@ -906,7 +945,7 @@ def main(argv=None) -> int:
             )
         args = parser.parse_args(rest)
         if args.problem in ("lint", "check", "report", "watch", "profile",
-                            "serve", "submit", "warmup"):
+                            "serve", "submit", "warmup", "top"):
             parser.error("profile wraps a search run, not another "
                          "subcommand")
         args.phase_profile = True
@@ -941,6 +980,13 @@ def main(argv=None) -> int:
         return watch_main(args.port or 8642, host=args.host,
                           interval=args.interval, once=args.once,
                           as_json=args.watch_json)
+    if args.problem == "top":
+        # Pure HTTP client of a serve daemon: no jax import.
+        from .serve.client import top_main
+
+        return top_main(port=args.port, host=args.host,
+                        interval=args.interval, once=args.once,
+                        as_json=args.top_json)
     if args.problem == "serve":
         # The daemon: jax stays out of the HTTP threads (scheduler
         # workers import the engines lazily on the first slice).

@@ -49,6 +49,7 @@ from ..obs import counters as obs_counters
 from ..obs import events as ev
 from ..obs import flightrec as fr
 from ..obs import phases as obs_phases
+from ..obs import quality as obs_quality
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem
 from ..problems.nqueens import NQueensProblem
@@ -808,6 +809,9 @@ def resident_search(
     ph_total: dict | None = None  # per-phase ns totals (TTS_PHASEPROF=1)
     fb_tree = fb_sol = 0  # overflow-fallback host increments (obs parity)
     prev_best = best
+    # Anytime quality: None on the off path; otherwise records the
+    # incumbent trajectory from scalars consume() already reads.
+    qt = obs_quality.tracker(problem)
     n_disp = 0  # completed-dispatch sequence (flight-recorder registry)
     queue = DispatchQueue(depth)
     # Steady-state XLA capture (`tts profile` / --xla-trace): opens after
@@ -855,6 +859,8 @@ def resident_search(
                      best=best, tree=tree2, sol=sol2, depth=depth,
                      K=program.K, inflight=len(queue),
                      phases=ph_total)
+        if qt is not None:
+            qt.observe(best, n_disp, tree1 + tree2)
         if ev.enabled():
             now = ev.now_us()
             # Span semantics under pipelining (docs/OBSERVABILITY.md): the
@@ -943,6 +949,7 @@ def resident_search(
                 k_auto=k_auto,
                 obs=obs_result(),
                 phase_profile=ph_total,
+                quality=qt.result() if qt is not None else None,
             )
         if ctl is not None and cycles > 0 and ctl.observe(period, cycles):
             # Geometric-ladder K resize: drain, then swap in the rung's
@@ -1014,6 +1021,9 @@ def resident_search(
     t3 = time.perf_counter()
     phases.append(PhaseStats(t3 - t2, tree3, sol3))
     ev.counter("explored", tree=tree3, sol=sol3, phase=3)
+    if qt is not None:
+        # The host drain can improve the incumbent one last time.
+        qt.observe(best, n_disp, tree1 + tree2 + tree3)
 
     return SearchResult(
         explored_tree=tree1 + tree2 + tree3,
@@ -1030,6 +1040,7 @@ def resident_search(
         k_auto=k_auto,
         obs=obs_result(),
         phase_profile=ph_total,
+        quality=qt.result() if qt is not None else None,
     )
 
 

@@ -86,6 +86,13 @@ class SearchResult:
     # D-invariant). None when the profiler is off. (`phases` above is the
     # host-side 3-phase wall-clock breakdown — a different axis.)
     phase_profile: dict | None = None
+    # Anytime quality telemetry (TTS_QUALITY=1 or a serve-bound recorder,
+    # obs/quality.py): {"optimum": best-known reference or None, "points":
+    # [{t_s, step, best, nodes}, ...]} — one point per incumbent
+    # improvement, harvested host-side at dispatch boundaries. None when
+    # the recorder is off (the default path records nothing and the
+    # compiled step is byte-identical either way).
+    quality: dict | None = None
 
     def workload_shares(self) -> list[float]:
         """Per-worker share of explored nodes (load-balance report,

@@ -42,6 +42,42 @@ MAX_EVENTS_PER_THREAD = 200_000
 #: tid used for communicator/coordinator tracks (clear of worker ids).
 COMM_TID = 1000
 
+# -- job correlation (serve) -----------------------------------------------
+# The serve scheduler runs many jobs through the same worker thread; a
+# merged trace over a daemon's state-dir is useless if every span is
+# anonymous. The scheduler binds the active job id per thread
+# (``with job_context(job_id):`` around each slice); ``emit`` stamps it
+# onto every event as a top-level ``"job"`` field, which the Chrome-trace
+# export (obs/export.py) turns into per-job lanes and ``tts report``
+# groups into per-job sections. Chrome/Perfetto ignore unknown fields,
+# so stamped traces stay loadable everywhere.
+
+_JOB_CTX = threading.local()
+
+
+def current_job() -> str | None:
+    """The job id bound to this thread, if any."""
+    return getattr(_JOB_CTX, "job", None)
+
+
+class job_context:
+    """``with job_context("job-000001"):`` — stamp every event this
+    thread emits with the job id. Nests (restores the previous binding);
+    ``None`` is a no-op binding."""
+
+    def __init__(self, job: str | None):
+        self._job = job
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_JOB_CTX, "job", None)
+        _JOB_CTX.job = self._job
+        return self
+
+    def __exit__(self, *exc):
+        _JOB_CTX.job = self._prev
+        return False
+
 
 def obs_mode() -> str:
     """The ``TTS_OBS`` knob: ``"0"``/unset = off, ``"1"`` = full (host
@@ -146,6 +182,9 @@ def emit(name: str, cat: str = "tts", ph: str = "i", wid: int = 0,
         ev["dur"] = dur
     if args:
         ev["args"] = args
+    job = getattr(_JOB_CTX, "job", None)
+    if job is not None:
+        ev["job"] = job
     _recorder.emit(ev)
 
 

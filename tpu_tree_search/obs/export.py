@@ -27,9 +27,35 @@ def _track_name(tid: int) -> str:
     return f"worker{tid}"
 
 
+#: tid base for synthetic per-job lanes (clear of worker ids and the
+#: communicator track).
+JOB_TID_BASE = 2000
+
+
+def _job_lanes(evts: list[dict]) -> tuple[list[dict], dict]:
+    """Remap job-stamped events (``events.job_context``, stamped by the
+    serve scheduler) onto one synthetic thread lane per job, so a merged
+    daemon trace renders per-job rows instead of interleaving every
+    tenant's spans on one worker track. Events without a ``job`` field
+    pass through untouched; lane ids are stable (sorted job order)."""
+    jobs = sorted({e["job"] for e in evts
+                   if isinstance(e, dict) and e.get("job") is not None})
+    if not jobs:
+        return evts, {}
+    lane = {j: JOB_TID_BASE + i for i, j in enumerate(jobs)}
+    out = []
+    for e in evts:
+        j = e.get("job") if isinstance(e, dict) else None
+        if j is not None:
+            e = {**e, "tid": lane[j]}
+        out.append(e)
+    return out, {lane[j]: j for j in jobs}
+
+
 def chrome_trace_object(evts: list[dict], label: str = "tts") -> dict:
     """The full Chrome-trace object for a drained event list (metadata
     process/thread-name records prepended for every (pid, tid) seen)."""
+    evts, job_lanes = _job_lanes(evts)
     meta: list[dict] = []
     pids = sorted({e.get("pid", 0) for e in evts})
     tracks = sorted({(e.get("pid", 0), e.get("tid", 0)) for e in evts})
@@ -41,7 +67,7 @@ def chrome_trace_object(evts: list[dict], label: str = "tts") -> dict:
     for pid, tid in tracks:
         meta.append({
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
-            "args": {"name": _track_name(tid)},
+            "args": {"name": job_lanes.get(tid) or _track_name(tid)},
         })
     other = {"producer": "tpu_tree_search obs"}
     # Dispatch-pipeline metadata (docs/OBSERVABILITY.md span semantics):

@@ -123,6 +123,12 @@ def _aggregate(now: float, tier: str, last: list[dict], idle_count: int,
         if dom is not None:
             snap_phase["dominant_phase"] = dom[0]
             snap_phase["dominant_phase_share"] = round(dom[1], 4)
+    # Job correlation (serve): the scheduler stamps the bound recorder's
+    # meta with the job id/class; surfacing them here puts the job on
+    # every SSE frame and dumped snapshot.
+    for k in ("job", "cls"):
+        if meta.get(k) is not None:
+            snap_phase[k] = meta[k]
     return {
         **snap_phase,
         "ts_us": now,

@@ -114,16 +114,24 @@ def sse_event(handler: BaseHTTPRequestHandler, payload: dict,
 
 def stream_snapshots(handler: BaseHTTPRequestHandler, latest_fn,
                      stop_fn=None, poll_s: float = STREAM_POLL_S,
-                     final_fn=None) -> None:
+                     final_fn=None, events_fn=None) -> None:
     """Poll ``latest_fn()`` and push each NEW snapshot (by ``ts_us``) as an
     SSE frame until ``stop_fn()`` goes true. ``final_fn()`` (optional) may
     return one terminal payload, sent as an ``event: done`` frame — the
     serve daemon closes a finished job's stream with its result record so
-    a client needs no second round trip."""
+    a client needs no second round trip. ``events_fn()`` (optional) may
+    return a list of ``(event_name, payload)`` extra frames, drained every
+    poll AND once more before the ``done`` frame — the serve daemon uses
+    it for ``event: incumbent`` quality frames, and the final drain
+    guarantees every incumbent recorded during the run is on the wire
+    before the stream closes."""
     last_ts = None
 
     def push_new() -> None:
         nonlocal last_ts
+        if events_fn is not None:
+            for name, payload in events_fn():
+                sse_event(handler, payload, event=name)
         snap = latest_fn()
         if snap is not None and snap.get("ts_us") != last_ts:
             last_ts = snap.get("ts_us")
@@ -257,12 +265,14 @@ def watch_main(port: int, host: str = "127.0.0.1", interval: float = 1.0,
     from urllib.request import urlopen
 
     seen = 0
+    last_ts = None  # carried into the fallback: no duplicate reprint
     try:
         try:
             with urlopen(base + "/stream", timeout=30.0) as resp:  # noqa: S310
                 for _event, snap in iter_sse(resp):
                     emit(snap)
                     seen += 1
+                    last_ts = snap.get("ts_us", last_ts)
                     if max_updates is not None and seen >= max_updates:
                         return 0
         except OSError as e:
@@ -272,7 +282,6 @@ def watch_main(port: int, host: str = "127.0.0.1", interval: float = 1.0,
                 return 2
         # Stream dropped (run over or timeout): fall back to polling until
         # the server goes away entirely.
-        last_ts = None
         while max_updates is None or seen < max_updates:
             try:
                 snap = _fetch_json(base + "/snapshot")

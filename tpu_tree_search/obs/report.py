@@ -174,6 +174,61 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
             "overflow_cycles": counters_total.get("overflow", 0),
         }
 
+    # -- per-job lanes (serve traces; events.job_context stamps) -----------
+    # A merged daemon trace interleaves every tenant's events; the job
+    # field (stamped by the scheduler around each slice) groups them back
+    # into the per-job view an operator reads.
+    jobs_seen = sorted({e["job"] for e in evts if e.get("job") is not None})
+    job_lanes: dict = {}
+    for j in jobs_seen:
+        je = [e for e in evts if e.get("job") == j]
+        jt0, jt1 = _span_us(je)
+        disp = [e for e in je if e.get("name") in ("dispatch", "chunk")]
+        bests = [
+            b for b in ((e.get("args") or {}).get("best") for e in disp)
+            if b is not None
+        ]
+        job_lanes[j] = {
+            "events": len(je),
+            "dispatches": len(disp),
+            "span_s": round(max(jt1 - jt0, 0.0) / 1e6, 6),
+            "best": min(bests) if bests else None,
+        }
+
+    # -- anytime quality (obs/quality.py; incumbent + quality_ref events) --
+    refs = [e for e in evts if e.get("name") == "quality_ref"]
+    ref_args = (refs[-1].get("args") or {}) if refs else {}
+    optimum = ref_args.get("optimum")
+    incumbents = [e for e in evts if e.get("name") == "incumbent"]
+    quality = None
+    if incumbents:
+        from . import quality as quality_mod
+
+        by_job: dict = {}
+        for e in incumbents:
+            by_job.setdefault(e.get("job") or "-", []).append({
+                "t_s": round(max(0.0, e.get("ts", 0.0) - t0) / 1e6, 6),
+                "best": (e.get("args") or {}).get("best"),
+            })
+        jobs_q = {}
+        for key, pts in sorted(by_job.items()):
+            pts.sort(key=lambda p: p["t_s"])
+            for p in pts:
+                g = quality_mod.primal_gap(p["best"], optimum)
+                p["gap"] = None if g is None else round(g, 6)
+            pi = quality_mod.primal_integral(pts, optimum, span_s)
+            jobs_q[key] = {
+                "points": pts,
+                "final_best": pts[-1]["best"],
+                "final_gap": pts[-1]["gap"],
+                "primal_integral": None if pi is None else round(pi, 6),
+            }
+        quality = {
+            "instance": ref_args.get("instance"),
+            "optimum": optimum,
+            "jobs": jobs_q,
+        }
+
     return {
         "events": len(evts),
         "span_s": round(span_s, 6),
@@ -184,6 +239,8 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
         "device_counters": counters_total,
         "survivor_path": survivor,
         "phase_decomp": phase_decomp,
+        "jobs": job_lanes,
+        "quality": quality,
     }
 
 
@@ -285,6 +342,37 @@ def render(summary: dict) -> str:
                if sp["push_rows_per_survivor"] is not None else "")
             + f", {sp['overflow_cycles']} overflow cycle(s)"
         )
+    if summary.get("jobs"):
+        out.append("per-job lanes:")
+        for j, info in summary["jobs"].items():
+            out.append(
+                f"  {j}: {info['events']} event(s), "
+                f"{info['dispatches']} dispatch(es) over "
+                f"{info['span_s']:.3f}s"
+                + (f", best={info['best']}"
+                   if info["best"] is not None else "")
+            )
+    if summary.get("quality"):
+        q = summary["quality"]
+        head = "quality vs time"
+        if q.get("instance") and q.get("optimum") is not None:
+            head += f" (instance {q['instance']}, optimum {q['optimum']})"
+        out.append(head + ":")
+        for key, jq in q["jobs"].items():
+            label = "" if key == "-" else f"{key}: "
+            for p in jq["points"]:
+                gap = ("gap ?" if p["gap"] is None
+                       else f"gap {100.0 * p['gap']:6.2f}%")
+                out.append(
+                    f"  {label}t={p['t_s']:8.3f}s  best={p['best']}  {gap}"
+                )
+            tail = []
+            if jq["final_gap"] is not None:
+                tail.append(f"final gap {100.0 * jq['final_gap']:.2f}%")
+            if jq["primal_integral"] is not None:
+                tail.append(f"primal integral {jq['primal_integral']:.4f}")
+            if tail:
+                out.append(f"  {label}" + ", ".join(tail))
     return "\n".join(out)
 
 

@@ -43,6 +43,7 @@ from ..obs import counters as obs_counters
 from ..obs import events as ev
 from ..obs import flightrec as fr
 from ..obs import phases as obs_phases
+from ..obs import quality as obs_quality
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem, batch_length, index_batch
 from .dist import (
@@ -197,6 +198,9 @@ def _host_loop(
     ctr_total: dict | None = None
     ph_total: dict | None = None  # per-phase ns totals (TTS_PHASEPROF=1)
     prev_best = best
+    # Anytime quality (host-local trajectory, like the obs counters; an
+    # exchange-adopted global incumbent lands at the next dispatch read).
+    qt = obs_quality.tracker(problem)
     sizes = np.zeros(D, dtype=np.int32)
     n_disp = 0  # completed-dispatch sequence (flight-recorder registry)
     queue = DispatchQueue(depth)
@@ -233,6 +237,8 @@ def _host_loop(
                      size=int(sizes.sum()), best=int(best), tree=tree2,
                      sol=sol2, depth=depth, K=program.K,
                      inflight=len(queue), phases=ph_total)
+        if qt is not None:
+            qt.observe(best, n_disp, tree1 + tree2)
         if ev.enabled():
             now = ev.now_us()
             ev.emit("dispatch", ph="X", ts=t_enq, host=me,
@@ -475,6 +481,9 @@ def _host_loop(
     tree3, sol3, best = drain(problem, pool, best)
     t3 = time.perf_counter()
     ev.counter("explored", host=me, tree=tree3, sol=sol3, phase=3)
+    if qt is not None:
+        # The host drain can improve the incumbent one last time.
+        qt.observe(best, n_disp, tree1 + tree2 + tree3)
 
     return {
         "tree": tree1 + tree2 + tree3,
@@ -518,6 +527,8 @@ def _host_loop(
         ),
         # Host-local per-phase ns totals (TTS_PHASEPROF=1, obs/phases.py).
         "phase_profile": ph_total,
+        # Host-local incumbent trajectory (obs/quality.py; not reduced).
+        "quality": qt.result() if qt is not None else None,
     }
 
 
@@ -541,6 +552,7 @@ def _reduce(local: dict, coll) -> SearchResult:
         k_auto=local.get("k_auto", False),
         obs=local.get("obs"),
         phase_profile=local.get("phase_profile"),
+        quality=local.get("quality"),
     )
 
 

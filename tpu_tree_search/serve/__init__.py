@@ -31,4 +31,10 @@ from __future__ import annotations
 
 DEFAULT_PORT = 8643  # one above obs/live's default watch port
 
-__all__ = ["DEFAULT_PORT"]
+#: Daemon version, surfaced on ``/healthz`` and ``/metrics``
+#: (``tts_serve_build_info``) so fleet tooling can tell which daemons
+#: still need a rolling restart. Bump when the HTTP API or job-record
+#: schema changes.
+VERSION = "0.11.0"
+
+__all__ = ["DEFAULT_PORT", "VERSION"]

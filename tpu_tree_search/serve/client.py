@@ -1,10 +1,13 @@
-"""Thin clients for the serve daemon: ``tts submit`` / ``tts watch --job``.
+"""Thin clients for the serve daemon: ``tts submit`` / ``tts watch --job``
+/ ``tts top``.
 
 Pure stdlib HTTP (urllib) against 127.0.0.1 — no jax import on any path
 here, same discipline as ``obs/live.watch_main``. The submit client
 converts CLI run arguments into a job spec (reusing the main parser's
 validation via ``tts submit -- <run args>``), posts it, and either
 returns the id immediately or follows the job's SSE stream to completion.
+``tts top`` is the operator console: a periodically refreshed per-job /
+per-class table assembled from ``/healthz`` + ``/jobs`` + ``/classes``.
 """
 
 from __future__ import annotations
@@ -93,7 +96,8 @@ def submit_main(spec: dict, port: int = DEFAULT_PORT,
         return 2
     if code != 201:
         print(f"Error: submit rejected ({code}): "
-              f"{payload.get('error', payload)}", file=sys.stderr)
+              f"{payload.get('error', payload)}{_daemon_tag(base)}",
+              file=sys.stderr)
         return 2
     if not wait:
         if as_json:
@@ -105,7 +109,9 @@ def submit_main(spec: dict, port: int = DEFAULT_PORT,
         return 0
     rec = follow_job(base, payload["id"],
                      emit=None if as_json else
-                     (lambda s: print(format_snapshot(s), flush=True)))
+                     (lambda s: print(format_snapshot(s), flush=True)),
+                     on_incumbent=None if as_json else
+                     (lambda p: print(_format_incumbent(p), flush=True)))
     if rec is None:
         print(f"Error: lost job {payload['id']}", file=sys.stderr)
         return 2
@@ -114,6 +120,30 @@ def submit_main(spec: dict, port: int = DEFAULT_PORT,
     else:
         _print_final(rec)
     return 0 if rec.get("state") == "done" else 1
+
+
+def _daemon_tag(base: str) -> str:
+    """`` [daemon v0.11.0, up 42s, workers 1/1 alive]`` for error
+    messages — a rejected submit should say WHICH daemon rejected it and
+    whether its workers are even running (a dead worker thread otherwise
+    hides behind a listening socket)."""
+    try:
+        code, h = _get(base + "/healthz", timeout=2.0)
+    except (URLError, OSError):
+        return ""
+    if code != 200 or not isinstance(h, dict):
+        return ""
+    return (f" [daemon v{h.get('version', '?')}, "
+            f"up {h.get('uptime_s', 0):.0f}s, "
+            f"workers {h.get('workers_alive', '?')}/{h.get('workers', '?')}"
+            f" alive]")
+
+
+def _format_incumbent(p: dict) -> str:
+    """One human line per quality-trajectory improvement."""
+    return (f"  incumbent #{p.get('n', '?')}: best={p.get('best')}"
+            f"  t={p.get('t_s', 0.0):.3f}s  step={p.get('step')}"
+            f"  nodes={p.get('nodes')}")
 
 
 def _print_final(rec: dict) -> None:
@@ -125,11 +155,21 @@ def _print_final(rec: dict) -> None:
           + (f"  error={rec['error']}" if rec.get("error") else ""))
 
 
-def follow_job(base: str, jid: str, emit=None, timeout_s: float = 600.0):
+def follow_job(base: str, jid: str, emit=None, timeout_s: float = 600.0,
+               on_incumbent=None):
     """Stream a job's SSE until its ``done`` frame; fall back to polling
     if the stream drops (daemon restart). Returns the final job record or
-    None."""
+    None. ``on_incumbent`` receives each NEW ``event: incumbent`` quality
+    frame (deduped by its monotone ``n`` index across reconnects).
+
+    Dedupe: the server re-sends a job's latest snapshot (and every
+    incumbent so far) on each NEW stream connection, so this reconnect
+    loop would re-print identical frames once per retry interval on a
+    quiet job. Snapshots are deduped by their ``(ts_us, seq)`` identity,
+    incumbents by ``n`` — both survive any number of reconnects."""
     deadline = time.monotonic() + timeout_s
+    last_key = None  # (ts_us, seq) of the last emitted snapshot
+    max_n = 0  # highest incumbent index emitted
     while time.monotonic() < deadline:
         try:
             req = base + f"/job/{jid}/stream"
@@ -137,6 +177,18 @@ def follow_job(base: str, jid: str, emit=None, timeout_s: float = 600.0):
                 for event, payload in iter_sse(resp):
                     if event == "done":
                         return payload
+                    if event == "incumbent":
+                        n = int(payload.get("n") or 0)
+                        if n and n <= max_n:
+                            continue  # reconnect replayed an old frame
+                        max_n = max(max_n, n)
+                        if on_incumbent is not None:
+                            on_incumbent(payload)
+                        continue
+                    key = (payload.get("ts_us"), payload.get("seq"))
+                    if key == last_key:
+                        continue
+                    last_key = key
                     if emit is not None:
                         emit(payload)
         except (OSError, ValueError):
@@ -179,25 +231,119 @@ def watch_job_main(jid: str, port: int = DEFAULT_PORT,
                 f"{rec['id']}: {rec['state']}"
             )
         return 0
-    seen = 0
+    # Delegate to follow_job: it owns the reconnect/poll fallback AND the
+    # cross-reconnect dedupe (the old inline loop re-printed the latest
+    # snapshot after every stream drop).
+    seen = {"n": 0}
+
+    def bounded_emit(s):
+        emit(s)
+        seen["n"] += 1
+        if max_updates is not None and seen["n"] >= max_updates:
+            raise _Enough
+
+    on_inc = ((lambda p: print(json.dumps({"incumbent": p}), flush=True))
+              if as_json else
+              (lambda p: print(_format_incumbent(p), flush=True)))
     try:
-        req = base + f"/job/{jid}/stream"
-        with urlopen(req, timeout=600.0) as resp:  # noqa: S310
-            for event, payload in iter_sse(resp):
-                if event == "done":
-                    if as_json:
-                        print(json.dumps(payload))
-                    else:
-                        _print_final(payload)
-                    return 0
-                emit(payload)
-                seen += 1
-                if max_updates is not None and seen >= max_updates:
-                    return 0
+        final = follow_job(base, jid, emit=bounded_emit,
+                           on_incumbent=on_inc)
+    except (_Enough, KeyboardInterrupt):
+        return 0
+    if final is None:
+        print(f"Error: lost job {jid}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(final))
+    else:
+        _print_final(final)
+    return 0
+
+
+class _Enough(Exception):
+    """Raised by a bounded watch to cut the stream after --max-updates."""
+
+
+# -- the `tts top` operator console ------------------------------------------
+
+
+def _render_top(health: dict, jobs: list, classes: dict) -> str:
+    """The ``tts top`` display: daemon header, per-class occupancy table,
+    then per-job rows (active work first, newest terminal jobs last)."""
+    lines = []
+    ok = health.get("ok", False)
+    lines.append(
+        f"tts serve v{health.get('version', '?')}"
+        f"  up {health.get('uptime_s', 0):.0f}s"
+        f"  queue={health.get('queue_depth', 0)}"
+        f"  workers={health.get('workers_alive', '?')}"
+        f"/{health.get('workers', '?')}"
+        + ("" if ok else "  [DEGRADED: no alive worker]")
+    )
+    by_state: dict = {}
+    for j in jobs:
+        by_state[j.get("state", "?")] = by_state.get(j.get("state", "?"), 0) + 1
+    lines.append("jobs: " + ("  ".join(
+        f"{s}={n}" for s, n in sorted(by_state.items())) or "none"))
+    if classes:
+        lines.append("")
+        lines.append(f"{'class':<44} {'warm':>4} {'progs':>5} "
+                     f"{'steps':>5} {'jobs':>5}")
+        for st in sorted(classes, key=lambda st: st.get("class", "")):
+            lines.append(
+                f"{(st.get('class') or '?')[:44]:<44} "
+                f"{'y' if st.get('warm') else '-':>4} "
+                f"{st.get('programs', 0):>5} "
+                f"{st.get('step_cache_entries', 0):>5} "
+                f"{st.get('jobs_admitted', 0):>5}")
+    active = [j for j in jobs
+              if j.get("state") in ("running", "queued", "requeued")]
+    finished = [j for j in jobs if j not in active]
+    rows = active + finished[-5:]  # full active set + recent history
+    if rows:
+        lines.append("")
+        lines.append(f"{'job':<12} {'state':<9} {'class':<36} "
+                     f"{'slices':>6} {'preempt':>7} {'steps':>9} {'best':>8}")
+        for j in rows:
+            res = j.get("result") or {}
+            q = (res.get("quality") or {}).get("points") or []
+            best = res.get("best", q[-1]["best"] if q else None)
+            lines.append(
+                f"{j.get('id', '?'):<12} {j.get('state', '?'):<9} "
+                f"{(j.get('class') or '?')[:36]:<36} "
+                f"{j.get('slices', 0):>6} {j.get('preemptions', 0):>7} "
+                f"{j.get('steps', 0):>9} "
+                f"{best if best is not None else '-':>8}")
+    return "\n".join(lines)
+
+
+def top_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
+             interval: float = 2.0, once: bool = False,
+             as_json: bool = False) -> int:
+    """``tts top``: live per-job / per-class daemon table (the serve
+    analogue of ``tts watch``'s single-run status line). ``--once``
+    prints one frame and exits (CI smoke); ``--json`` emits the raw
+    composed payload per refresh."""
+    base = f"http://{host}:{port}"
+    try:
+        while True:
+            try:
+                _, health = _get(base + "/healthz", timeout=5.0)
+                _, jobs = _get(base + "/jobs", timeout=5.0)
+                _, classes = _get(base + "/classes", timeout=5.0)
+            except (URLError, OSError) as e:
+                print(f"Error: no serve daemon at {base}: {e}",
+                      file=sys.stderr)
+                return 2
+            if as_json:
+                print(json.dumps({"health": health, "jobs": jobs,
+                                  "classes": classes}), flush=True)
+            else:
+                if not once and sys.stdout.isatty():
+                    print("\x1b[2J\x1b[H", end="")
+                print(_render_top(health, jobs, classes), flush=True)
+            if once:
+                return 0
+            time.sleep(interval)
     except KeyboardInterrupt:
         return 0
-    except OSError as e:
-        if seen == 0:
-            print(f"Error: stream failed: {e}", file=sys.stderr)
-            return 2
-    return 0

@@ -186,6 +186,8 @@ def result_record(res) -> dict:
             rec["k_auto"] = True
     if res.obs:
         rec["obs"] = res.obs
+    if res.quality and res.quality.get("points"):
+        rec["quality"] = res.quality
     return rec
 
 
@@ -223,6 +225,10 @@ class Job:
         # Runtime-only (not persisted):
         self.cancel_requested = False
         self.recorder = None  # per-job FlightRecorder, bound during slices
+        # Per-job QualityRecorder (obs/quality.py), bound during slices;
+        # spans preemptions so the trajectory covers the whole job. The
+        # stream handler polls .points() for SSE `incumbent` frames.
+        self.quality = None
 
     def record(self) -> dict:
         """The persisted/public JSON view."""

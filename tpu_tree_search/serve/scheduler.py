@@ -78,27 +78,47 @@ class Scheduler:
     and the backend multiplexes)."""
 
     def __init__(self, registry, pool, workers: int = 1,
-                 quantum_s: float = 5.0, state_dir: str = "."):
+                 quantum_s: float = 5.0, state_dir: str = ".",
+                 metrics=None):
         self.registry = registry
         self.pool = pool
         self.workers = max(1, int(workers))
         self.quantum_s = float(quantum_s)
         self.state_dir = state_dir
+        # serve/metrics.ServeMetrics (or None when embedded without a
+        # daemon). Its lock is a leaf: inc/observe never call out, so
+        # recording from any point here cannot invert the lock order.
+        self.metrics = metrics
         self.lease = EnvLease()
         self._cv = threading.Condition()
         self._queue = deque()  # guarded-by: _cv  (job ids)
         self._stopping = False  # guarded-by: _cv
         self._active = 0  # guarded-by: _cv  (jobs inside a slice)
         self._threads = []
+        self.started = False
 
     # -- queue side (HTTP thread + workers) --------------------------------
 
     def start(self) -> None:
+        self.started = True
         for i in range(self.workers):
             t = threading.Thread(target=self._worker, args=(i,),
                                  name=f"tts-serve-worker-{i}", daemon=True)
             t.start()
             self._threads.append(t)
+
+    def workers_alive(self) -> int:
+        """Worker threads still running (``/healthz`` ``workers_alive``).
+        ``_threads`` is append-only from ``start``; no lock needed."""
+        return sum(1 for t in self._threads if t.is_alive())
+
+    def _inc(self, name: str, labels=None, v: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, labels, v)
+
+    def _observe(self, name: str, value: float, labels=None) -> None:
+        if self.metrics is not None:
+            self.metrics.observe(name, value, labels)
 
     def submit(self, job) -> int:
         """Enqueue an admitted job; returns its queue position."""
@@ -199,7 +219,9 @@ class Scheduler:
         return os.path.join(self.state_dir, "jobs", f"{job.id}.ckpt.npz")
 
     def _run_slice(self, job, wid: int) -> None:
+        from ..obs import events as obs_events
         from ..obs import flightrec
+        from ..obs import quality as obs_quality
 
         if job.cancel_requested:
             # Cancel raced the job off the queue: honour it before spending
@@ -213,6 +235,12 @@ class Scheduler:
         if not self.registry.transition_if(job, ("queued", "requeued"),
                                            "running", slices=job.slices + 1):
             return  # a racing cancel won; never flip a terminal state back
+        if job.slices == 1:
+            # First slice: submit-to-start is the job's queue wait.
+            self._observe("tts_serve_queue_wait_seconds",
+                          max(0.0, (job.started or time.time())
+                              - job.submitted),
+                          {"cls": job.class_key})
         if job.recorder is None:
             # Private ring per job: never installs process-wide handlers;
             # always_on makes it record without TTS_OBS.
@@ -223,6 +251,11 @@ class Scheduler:
             )
             with job.recorder._lock:
                 job.recorder._meta.update(job=job.id, cls=job.class_key)
+        if job.quality is None:
+            # Per-job incumbent trajectory (obs/quality.py): always on for
+            # serve jobs, bound per slice; spans preemptions.
+            job.quality = obs_quality.QualityRecorder()
+        job.quality.step_offset = job.steps
         ckpt = self._checkpoint_path(job)
         quantum = self.quantum_s
         t0 = time.monotonic()  # restarted below, once the env lease is held
@@ -246,14 +279,18 @@ class Scheduler:
         )
         if job.spec.get("K") is not None:
             kw["K"] = job.spec["K"]
+        t_lease = time.monotonic()
         self.lease.acquire(job.pins)
         # Quantum clock starts AFTER the lease: time blocked waiting for a
         # conflicting env pin is queueing, not run time — charging it would
         # preempt a contended pinned job at its first dispatch boundary
         # every slice.
         t0 = time.monotonic()
+        self._observe("tts_serve_lease_wait_seconds", t0 - t_lease)
         try:
-            with flightrec.bound(job.recorder):
+            with flightrec.bound(job.recorder), \
+                    obs_quality.bound(job.quality), \
+                    obs_events.job_context(job.id):
                 if job.spec["tier"] == "mesh":
                     from ..parallel.resident_mesh import mesh_resident_search
 
@@ -270,6 +307,10 @@ class Scheduler:
             return
         finally:
             self.lease.release()
+            # Counted in `finally` so failed slices land in the series too.
+            self._observe("tts_serve_run_seconds", time.monotonic() - t0,
+                          {"cls": job.class_key})
+            self._inc("tts_serve_slices_total", {"cls": job.class_key})
         prog1, step1 = pool_mod.compile_stats(problem)
         self.registry.update(
             job,
@@ -301,12 +342,14 @@ class Scheduler:
             return
         if self._stop_requested():
             # Daemon drain: preserve the cut for the next daemon.
+            self._inc("tts_serve_requeues_total")
             self.registry.transition(
                 job, "requeued",
                 checkpoint=ckpt if has_ckpt else job.checkpoint,
             )
             return
         # Quantum preemption: back of the queue, resume from the cut.
+        self._inc("tts_serve_preemptions_total")
         self.registry.update(
             job, preemptions=job.preemptions + 1,
             checkpoint=ckpt if has_ckpt else job.checkpoint,
@@ -315,4 +358,5 @@ class Scheduler:
         try:
             self.submit(job)
         except RuntimeError:
+            self._inc("tts_serve_requeues_total")
             self.registry.transition(job, "requeued")

@@ -19,10 +19,17 @@ API (all JSON):
     next dispatch boundary; 409 when already finished.
   * ``GET  /job/<id>/stream``    — SSE: one frame per new snapshot from
     the job's private flight-recorder ring (incumbent, nodes/s, pool
-    occupancy ...), closed by an ``event: done`` frame carrying the
-    final job record — one connection is the whole job story.
+    occupancy ...) plus ``event: incumbent`` frames — one per recorded
+    quality-trajectory improvement, all flushed before the terminal
+    ``event: done`` frame carrying the final job record — one connection
+    is the whole job story.
   * ``GET  /classes``            — program-pool stats per shape class.
-  * ``GET  /healthz``            — liveness + queue depth.
+  * ``GET  /metrics``            — Prometheus text format (serve/metrics.py):
+    queue depth, jobs by state/class, admission outcomes, pool occupancy,
+    compile deltas, preemptions, wait/run histograms.
+  * ``GET  /healthz``            — liveness + queue depth + ``uptime_s``,
+    ``version`` and ``workers_alive`` (a dead worker thread must not hide
+    behind a healthy-looking HTTP surface).
   * ``POST /shutdown``           — graceful drain (same path as SIGTERM).
 """
 
@@ -38,7 +45,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse
 
 from ..obs.live import sse_begin, stream_snapshots
-from . import DEFAULT_PORT
+from . import DEFAULT_PORT, VERSION
+from . import metrics as metrics_mod
 from .jobs import JobRegistry, validate_spec
 from .pool import ProgramPool
 from .scheduler import Scheduler
@@ -64,9 +72,12 @@ class ServeDaemon:
         self.registry = JobRegistry(self.state_dir)
         self.loaded = self.registry.load()
         self.pool = ProgramPool()
+        self.metrics = metrics_mod.ServeMetrics()
+        self.started = time.time()
         self.scheduler = Scheduler(self.registry, self.pool, workers=workers,
                                    quantum_s=quantum_s,
-                                   state_dir=self.state_dir)
+                                   state_dir=self.state_dir,
+                                   metrics=self.metrics)
         self.max_queue = max_queue
         self.stop_event = threading.Event()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -100,8 +111,12 @@ class ServeDaemon:
         try:
             spec = validate_spec(spec)
         except ValueError as e:
+            self.metrics.inc("tts_serve_admissions_total",
+                             {"outcome": "invalid"})
             return {"error": str(e)}, 400
         if self.scheduler.queue_depth() >= self.max_queue:
+            self.metrics.inc("tts_serve_admissions_total",
+                             {"outcome": "queue_full"})
             return {"error": f"queue full ({self.max_queue})"}, 503
         cls = self.pool.peek(spec)
         from .jobs import job_pins
@@ -112,9 +127,32 @@ class ServeDaemon:
             pos = self.scheduler.submit(job)
         except RuntimeError:
             self.registry.transition(job, "requeued")
+            self.metrics.inc("tts_serve_admissions_total",
+                             {"outcome": "draining"})
             return {"error": "daemon is draining"}, 503
+        self.metrics.inc("tts_serve_admissions_total",
+                         {"outcome": "admitted"})
         return {"id": job.id, "class": cls["class"], "warm": cls["warm"],
                 "position": pos}, 201
+
+    def health(self) -> dict:
+        """The ``/healthz`` payload. ``workers_alive`` counts scheduler
+        worker threads still running — the PR-10 worker wrap makes a
+        per-job crash survivable, but an exhausted/killed worker thread
+        would otherwise leave a daemon that admits jobs and never runs
+        them; ``ok`` goes false in that state so probes (and the submit
+        client's error message) surface it."""
+        alive = self.scheduler.workers_alive()
+        started = self.scheduler.started
+        return {
+            "ok": alive > 0 or not started,
+            "queue_depth": self.scheduler.queue_depth(),
+            "jobs": len(self.registry.all()),
+            "uptime_s": round(max(0.0, time.time() - self.started), 3),
+            "version": VERSION,
+            "workers": self.scheduler.workers,
+            "workers_alive": alive,
+        }
 
     def shutdown(self) -> None:
         """Graceful drain; idempotent (SIGTERM and POST /shutdown share
@@ -165,12 +203,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json([j.record() for j in self.daemon.registry.all()])
             elif path == "/classes":
                 self._json(self.daemon.pool.stats())
+            elif path == "/metrics":
+                body = metrics_mod.render(self.daemon).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", metrics_mod.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif path == "/healthz":
-                self._json({
-                    "ok": True,
-                    "queue_depth": self.daemon.scheduler.queue_depth(),
-                    "jobs": len(self.daemon.registry.all()),
-                })
+                self._json(self.daemon.health())
             elif path.startswith("/job/"):
                 parts = path.split("/")  # ['', 'job', '<id>', ...]
                 job = self._job(parts[2]) if len(parts) >= 3 else None
@@ -184,6 +225,8 @@ class _Handler(BaseHTTPRequestHandler):
                                     "result": job.result,
                                     "error": job.error})
                     else:
+                        self.daemon.metrics.inc("tts_serve_conflicts_total",
+                                                {"endpoint": "result"})
                         self._json({"error": f"job is {job.state}",
                                     "state": job.state}, code=409)
                 elif parts[3] == "stream":
@@ -219,6 +262,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json({"id": job.id, "state": job.state,
                                 "cancelling": True})
                 else:
+                    self.daemon.metrics.inc("tts_serve_conflicts_total",
+                                            {"endpoint": "cancel"})
                     self._json({"error": f"job already {job.state}"},
                                code=409)
             else:
@@ -228,12 +273,32 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_job(self, job) -> None:
         """Per-job SSE: frames from the job's private recorder ring until
-        the job finishes, then the final record as ``event: done``."""
+        the job finishes, then the final record as ``event: done``.
+        Interleaved ``event: incumbent`` frames carry the job's quality
+        trajectory (obs/quality.py) as it improves; the stream layer
+        drains them once more before the ``done`` frame, so every
+        incumbent recorded during the run reaches the client before the
+        stream closes."""
         daemon = self.daemon
+        sent = 0  # incumbent points already on this connection
 
         def latest():
             rec = job.recorder
             return rec.latest() if rec is not None else None
+
+        def incumbents():
+            nonlocal sent
+            q = job.quality
+            if q is None:
+                return []
+            pts = q.points()
+            out = []
+            while sent < len(pts):
+                p = pts[sent]
+                sent += 1
+                # 1-based monotone index: clients dedupe reconnects by it.
+                out.append(("incumbent", {**p, "n": sent, "job": job.id}))
+            return out
 
         def stop():
             return (job.state in FINAL_STATES
@@ -242,7 +307,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         sse_begin(self, comment=f"tts job stream {job.id}")
         stream_snapshots(
-            self, latest, stop_fn=stop,
+            self, latest, stop_fn=stop, events_fn=incumbents,
             final_fn=lambda: job.record() if job.state in FINAL_STATES
             else None,
         )
@@ -274,7 +339,8 @@ def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
     if flightrec.enabled():
         flightrec.recorder().install()  # chains SIGTERM to _on_signal
     daemon.start()
-    print(f"Serving on {daemon.url} (state: {daemon.state_dir}, "
+    print(f"Serving on {daemon.url} (v{VERSION}, "
+          f"state: {daemon.state_dir}, "
           f"workers: {daemon.scheduler.workers}, "
           f"quantum: {daemon.scheduler.quantum_s:g}s"
           + (f", reloaded {daemon.loaded} job record(s)" if daemon.loaded
@@ -301,19 +367,26 @@ def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
     return 0
 
 
-def wait_port(url: str, timeout_s: float = 30.0) -> bool:
-    """Poll ``/healthz`` until the daemon answers (client/test helper)."""
+def wait_ready(url: str, timeout_s: float = 30.0) -> dict | None:
+    """Poll ``/healthz`` until the daemon answers; returns the health
+    payload (version, uptime_s, workers_alive ...) so callers can report
+    WHICH daemon answered — or a degraded one — not just that a socket
+    opened. ``None`` on timeout."""
     from urllib.request import urlopen
 
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         try:
             with urlopen(url + "/healthz", timeout=2.0) as resp:  # noqa: S310
-                json.loads(resp.read().decode())
-                return True
+                return json.loads(resp.read().decode())
         except (OSError, ValueError):
             time.sleep(0.1)
-    return False
+    return None
+
+
+def wait_port(url: str, timeout_s: float = 30.0) -> bool:
+    """Boolean convenience over :func:`wait_ready` (client/test helper)."""
+    return wait_ready(url, timeout_s=timeout_s) is not None
 
 
 if __name__ == "__main__":
