@@ -1103,6 +1103,69 @@ def _dispatch_latency_rows(extras: list, on_tpu: bool) -> None:
         })
 
 
+def _batch_ab_rows(extras: list) -> None:
+    """Instance-batching A/B on the CPU-sim harness (never fails the
+    bench): the same N same-shape-class jobs run serially through
+    ``resident_search`` vs through ``engine/batched.batched_search`` at
+    B in {1, 4, 8}. Reported per width: batch wall, aggregate nodes/s,
+    mean per-job latency, speedup over serial, and bit-identity of every
+    job against its solo run (the batching contract — a throughput win
+    that perturbed a single count would be a bug, not a result). B=1 is
+    the degenerate case and should run at ~serial speed. Wider batches
+    amortize per-dispatch host overhead across tenants — a device-side
+    effect: on the CPU sim the unrolled slots multiply per-cycle compute
+    (the off-chip bottleneck), so expect b4/b8 to LOSE here; the row's
+    job is structure + parity evidence, and the hardware session banks
+    the real speedup (scripts/hw_session.sh)."""
+    from tpu_tree_search.engine.batched import batched_search
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    n_jobs, m, M, K = 8, 5, 64, 8
+
+    def _mk():
+        return NQueensProblem(N=9)
+
+    try:
+        problem = _mk()
+        resident_search(problem, m=m, M=M, K=K)  # warm the solo program
+        t0 = time.perf_counter()
+        serial = [resident_search(problem, m=m, M=M, K=K)
+                  for _ in range(n_jobs)]
+        serial_s = time.perf_counter() - t0
+        golden = [(r.explored_tree, r.explored_sol, r.best) for r in serial]
+        nodes = sum(r.explored_tree for r in serial)
+        row = {
+            "metric": "batch_ab_sim",
+            "jobs": n_jobs,
+            "serial_s": round(serial_s, 3),
+            "serial_nodes_per_sec": round(nodes / max(serial_s, 1e-9), 1),
+            "serial_job_latency_ms": round(1e3 * serial_s / n_jobs, 2),
+        }
+        for B in (1, 4, 8):
+            batched_search(problem, n_jobs=B, B=B, m=m, M=M, K=K)  # warm
+            t0 = time.perf_counter()
+            results = batched_search(problem, n_jobs=n_jobs, B=B,
+                                     m=m, M=M, K=K)
+            wall = time.perf_counter() - t0
+            parity = (
+                [(r.explored_tree, r.explored_sol, r.best) for r in results]
+                == golden
+            )
+            row[f"b{B}_s"] = round(wall, 3)
+            row[f"b{B}_nodes_per_sec"] = round(nodes / max(wall, 1e-9), 1)
+            row[f"b{B}_job_latency_ms"] = round(1e3 * wall / n_jobs, 2)
+            row[f"b{B}_speedup"] = round(serial_s / max(wall, 1e-9), 3)
+            row[f"b{B}_parity"] = parity
+        row["parity"] = all(row[f"b{B}_parity"] for B in (1, 4, 8))
+        extras.append(row)
+    except Exception as e:  # noqa: BLE001 — A/B rows never fail a bench
+        extras.append({
+            "metric": "batch_ab_sim",
+            "error": f"{type(e).__name__}: {e}",
+        })
+
+
 def run_config(problem, m: int, M: int):
     """Warm-up run (compiles) + measured run; returns
     (result, nodes/s, elapsed, device_phase_s)."""
@@ -1409,6 +1472,10 @@ def _main(partial: BenchPartial) -> int:
         # the headline pipeline on/off A/B (TPU) and the simulated-latency
         # CPU harness row (every backend).
         _dispatch_latency_rows(extras, on_tpu)
+        # Instance-batching A/B: serial vs batched_search at B in
+        # {1, 4, 8}, bit-identity checked per job (CPU-sim, every
+        # backend — the --batch-slots evidence row).
+        _batch_ab_rows(extras)
     # Published-config rate rows run in BOTH modes (bounded — a few
     # dispatches each), so any green window banks a first ta021/N16/N17
     # number automatically.

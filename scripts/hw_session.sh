@@ -63,6 +63,44 @@ else
   echo "TRACED GUARDED RUN FAILED"
 fi
 
+echo "== 6b/9 batched-headline row (instance batching on the headline config) =="
+# The --batch-slots evidence on real hardware (docs/SERVING.md): the
+# headline PFSP class run as 8 concurrent tenants through the batched
+# engine at B in {1,4,8}, bounded by max_steps so each cell costs a few
+# dispatches. Bit-identity per job vs the serial run is asserted inline;
+# the aggregate-nodes/s row lands in BATCH_AB.json. Guard armed: a splice
+# that recompiled would fail loudly here, not in production.
+TTS_GUARD=1 timeout 900 python - <<'EOF' | tee BATCH_AB.json \
+  || echo "BATCHED HEADLINE FAILED"
+import json, time
+from tpu_tree_search.engine.batched import batched_search
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.problems import PFSPProblem
+
+prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+m, M, K, jobs = 25, 1024, 4096, 8
+resident_search(prob, m=m, M=M, K=K, max_steps=1)  # warm
+t0 = time.perf_counter()
+serial = [resident_search(prob, m=m, M=M, K=K) for _ in range(jobs)]
+serial_s = time.perf_counter() - t0
+golden = [(r.explored_tree, r.explored_sol, r.best) for r in serial]
+row = {"metric": "batch_ab_headline", "jobs": jobs,
+       "serial_s": round(serial_s, 3),
+       "serial_nodes_per_sec":
+           round(sum(r.explored_tree for r in serial) / serial_s, 1)}
+for B in (1, 4, 8):
+    batched_search(prob, n_jobs=B, B=B, m=m, M=M, K=K)  # warm
+    t0 = time.perf_counter()
+    res = batched_search(prob, n_jobs=jobs, B=B, m=m, M=M, K=K)
+    wall = time.perf_counter() - t0
+    assert [(r.explored_tree, r.explored_sol, r.best) for r in res] == golden
+    row[f"b{B}_s"] = round(wall, 3)
+    row[f"b{B}_nodes_per_sec"] = round(
+        sum(r.explored_tree for r in res) / wall, 1)
+    row[f"b{B}_speedup"] = round(serial_s / wall, 3)
+print(json.dumps(row))
+EOF
+
 echo "== 7/9 post-mortem + cost-model banking =="
 # Bank whatever the flight recorder dumped (a stage above that died on a
 # dead tunnel or hung dispatch left a post-mortem naming its last

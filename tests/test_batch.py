@@ -1,0 +1,391 @@
+"""Instance-axis batching (engine/batched.py + serve/batch.py): B-slot
+batched programs whose per-slot results are bit-identical to solo runs,
+zero-recompile slot splices at dispatch boundaries, per-slot quantum /
+cancel / budget semantics in the daemon, and cross-daemon checkpoint
+migration (`tts migrate`).
+
+Everything runs on the virtual CPU platform with small shapes; daemons
+under test are in-process (port 0). Batch tests submit every job BEFORE
+starting the scheduler workers: batch formation requires a same-class
+peer at the queue head, and pre-queued jobs make the session shape
+deterministic."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_tree_search.serve.server import ServeDaemon
+
+_FINAL = ("done", "failed", "cancelled")
+
+# One small shape shared across the daemon batch tests (fixed K: the
+# batch path requires it — an AdaptiveK job routes solo).
+NQ10K4 = {"problem": "nqueens", "N": 10, "M": 256, "K": 4}
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _wait_final(base, jid, timeout_s=180.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        code, rec = _get(base, f"/job/{jid}")
+        assert code == 200, rec
+        if rec["state"] in _FINAL:
+            return rec
+        time.sleep(0.1)
+    raise AssertionError(f"job {jid} did not finish in {timeout_s}s")
+
+
+def _wait_state(base, jid, state, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        code, rec = _get(base, f"/job/{jid}")
+        assert code == 200, rec
+        if rec["state"] == state:
+            return rec
+        assert rec["state"] not in _FINAL, rec
+        time.sleep(0.02)
+    raise AssertionError(f"job {jid} never reached {state!r}")
+
+
+def _start_http_only(d):
+    """Serve the HTTP API without workers, so submitted jobs stay queued
+    until `d.scheduler.start()` (same trick as the admission-control
+    test in test_serve.py)."""
+    d._http_thread = threading.Thread(
+        target=d._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+        daemon=True)
+    d._http_thread.start()
+
+
+def _reference(N, M, K, **kw):
+    """Standalone resident_search on a FRESH problem (what a one-shot
+    `tts run` computes)."""
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    return resident_search(NQueensProblem(N=N), m=25, M=M, K=K, **kw)
+
+
+def _counts(rec):
+    return (rec["result"]["explored_tree"], rec["result"]["explored_sol"],
+            rec["result"]["best"])
+
+
+# -- engine level ------------------------------------------------------------
+
+
+def test_batched_contracts_clean():
+    """The two pinned contracts: B=1 jaxpr byte-identity vs the solo
+    resident step, and make_slot avals == the compiled step's per-slot
+    input avals (the zero-recompile splice guarantee), at B in {1, 2}."""
+    from tpu_tree_search.analysis.program_audit import (
+        audit_batched, load_contracts,
+    )
+
+    load_contracts()
+    assert audit_batched() == []
+
+
+def test_engine_batched_bit_identity_and_refill():
+    """Every job through a B-slot program lands the solo counts exactly —
+    including n_jobs > B, which exercises retire-and-refill (a finished
+    slot's frozen ballast replaced by a fresh tenant)."""
+    from tpu_tree_search.engine.batched import batched_search
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    ref = resident_search(NQueensProblem(N=9), m=5, M=64, K=8)
+    golden = (ref.explored_tree, ref.explored_sol, ref.best)
+    for B, n_jobs in ((1, 2), (2, 5)):
+        results = batched_search(NQueensProblem(N=9), n_jobs=n_jobs, B=B,
+                                 m=5, M=64, K=8)
+        assert len(results) == n_jobs
+        for r in results:
+            assert (r.explored_tree, r.explored_sol, r.best) == golden
+            assert r.complete
+
+
+def test_engine_batched_obs_counters(monkeypatch):
+    """TTS_OBS=1 through the batched program: per-slot counter blocks are
+    harvested without perturbing any count."""
+    monkeypatch.setenv("TTS_OBS", "1")
+    from tpu_tree_search.engine.batched import batched_search
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    ref = resident_search(NQueensProblem(N=8), m=5, M=64, K=4)
+    for r in batched_search(NQueensProblem(N=8), n_jobs=3, B=2,
+                            m=5, M=64, K=4):
+        assert (r.explored_tree, r.explored_sol) == (
+            ref.explored_tree, ref.explored_sol)
+        assert r.obs and "device_counters" in r.obs
+
+
+# -- daemon level ------------------------------------------------------------
+
+
+def test_daemon_batch_bit_identity_and_zero_recompile_splice(
+    tmp_path, monkeypatch
+):
+    """The tentpole acceptance: three same-class jobs through a 2-slot
+    batch under TTS_GUARD=1 — every result bit-identical to solo, the
+    first job pays the one batched-program compile, and every SPLICED job
+    compiles NOTHING (program + jit cache deltas both zero)."""
+    monkeypatch.setenv("TTS_GUARD", "1")
+    ref = _reference(N=10, M=256, K=4)
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"),
+                    batch_slots=2)
+    _start_http_only(d)
+    try:
+        base = d.url
+        ids = [_post(base, "/submit", NQ10K4)[1]["id"] for _ in range(3)]
+        d.scheduler.start()
+        recs = [_wait_final(base, jid) for jid in ids]
+        for rec in recs:
+            assert rec["state"] == "done", rec.get("error")
+            assert _counts(rec) == (ref.explored_tree, ref.explored_sol,
+                                    ref.best)
+        assert recs[0]["new_programs"] >= 1  # cold class compiled once
+        for rec in recs[1:]:
+            assert rec["new_programs"] == 0
+            assert rec["new_step_compiles"] == 0
+        # A finished job has no checkpoint to serve.
+        code, err = _get(base, f"/job/{ids[0]}/checkpoint")
+        assert code == 409
+
+        # Batch telemetry landed on every surface.
+        from tpu_tree_search.serve.metrics import parse_text
+
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            m = parse_text(r.read().decode())
+        assert m["tts_serve_batch_slots"][()] == 2.0
+        assert sum(m["tts_serve_slots_spliced_total"].values()) >= 3
+        assert sum(m["tts_serve_slots_retired_total"].values()) >= 3
+        assert sum(m["tts_serve_batch_efficiency_count"].values()) >= 1
+        code, classes = _get(base, "/classes")
+        entry = next(c for c in classes if c["class"] == recs[0]["class"])
+        assert entry["batch_slots"] == 2
+        code, health = _get(base, "/healthz")
+        assert health["batch_slots"] == 2
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
+def test_daemon_batch_quantum_cut_bit_identity(tmp_path):
+    """quantum=0 with a waiter cuts live slots at every boundary: jobs
+    are checkpoint-cut out of the batch, requeued, and re-spliced — and
+    every final result still lands the solo counts exactly (a cut of one
+    slot never perturbs its neighbours)."""
+    ref = _reference(N=10, M=256, K=4)
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"),
+                    batch_slots=2, quantum_s=0.0)
+    _start_http_only(d)
+    try:
+        base = d.url
+        ids = [_post(base, "/submit", NQ10K4)[1]["id"] for _ in range(3)]
+        d.scheduler.start()
+        recs = [_wait_final(base, jid) for jid in ids]
+        for rec in recs:
+            assert rec["state"] == "done", rec.get("error")
+            assert _counts(rec) == (ref.explored_tree, ref.explored_sol,
+                                    ref.best)
+            assert rec["checkpoint"] is None  # consumed on completion
+        assert sum(r["preemptions"] for r in recs) > 0
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
+def test_daemon_batch_cancel_one_slot_leaves_other(tmp_path, monkeypatch):
+    """Cancelling one tenant mid-batch cuts exactly that slot (cancelled,
+    with a resumable checkpoint and a partial result); its neighbour runs
+    on to its budget bit-identically.
+
+    TTS_PIPELINE=0 pins the solo reference to the synchronous dispatch
+    sequence: a BUDGETED run's counts depend on how many dispatches
+    actually execute, and solo speculative pipelining drains extra
+    in-flight dispatches at the budget cut that the batched loop (which
+    has no speculation) never issues.  Complete runs are invariant."""
+    monkeypatch.setenv("TTS_PIPELINE", "0")
+    ref = _reference(N=12, M=256, K=2, max_steps=30)
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"),
+                    batch_slots=2)
+    _start_http_only(d)
+    try:
+        base = d.url
+        spec = {"problem": "nqueens", "N": 12, "M": 256, "K": 2}
+        _, s1 = _post(base, "/submit", {**spec, "max_steps": 30})
+        _, s2 = _post(base, "/submit", {**spec, "max_steps": 1 << 20})
+        d.scheduler.start()
+        _wait_state(base, s2["id"], "running")
+        code, _resp = _post(base, f"/job/{s2['id']}/cancel", {})
+        assert code == 200
+        rec2 = _wait_final(base, s2["id"])
+        assert rec2["state"] == "cancelled"
+        assert rec2["checkpoint"]  # cancel keeps the cut resumable
+        assert rec2["result"]["complete"] is False
+        rec1 = _wait_final(base, s1["id"])
+        assert rec1["state"] == "done", rec1.get("error")
+        assert rec1["steps"] == 30
+        assert _counts(rec1) == (ref.explored_tree, ref.explored_sol,
+                                 ref.best)
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
+def test_daemon_batch_budget_across_splices(tmp_path):
+    """A max_steps budget is cumulative across batch splices: under
+    quantum=0 churn the budgeted job is cut, requeued and re-spliced
+    repeatedly, finishing 'done' only once the whole budget is spent."""
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"),
+                    batch_slots=2, quantum_s=0.0)
+    _start_http_only(d)
+    try:
+        base = d.url
+        _, sa = _post(base, "/submit", {**NQ10K4, "max_steps": 6})
+        ids = [sa["id"]] + [_post(base, "/submit", NQ10K4)[1]["id"]
+                            for _ in range(2)]
+        d.scheduler.start()
+        recs = [_wait_final(base, jid) for jid in ids]
+        ref = _reference(N=10, M=256, K=4)
+        assert recs[0]["state"] == "done", recs[0].get("error")
+        assert recs[0]["steps"] == 6
+        assert recs[0]["result"]["complete"] is False
+        assert recs[0]["slices"] >= 2  # the budget spanned splices
+        for rec in recs[1:]:
+            assert rec["state"] == "done", rec.get("error")
+            assert _counts(rec) == (ref.explored_tree, ref.explored_sol,
+                                    ref.best)
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
+def test_daemon_batch_drain_requeues_live_slots(tmp_path):
+    """Daemon drain with a full batch in flight: every live slot is cut
+    to a checkpoint and requeued (resumable by the next daemon), never
+    recorded as finished or lost."""
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"),
+                    batch_slots=2)
+    _start_http_only(d)
+    try:
+        base = d.url
+        spec = {"problem": "nqueens", "N": 13, "M": 256, "K": 8,
+                "max_steps": 1 << 20}
+        ids = [_post(base, "/submit", spec)[1]["id"] for _ in range(2)]
+        d.scheduler.start()
+        for jid in ids:
+            _wait_state(base, jid, "running")
+        d.scheduler.drain(timeout_s=60.0)
+        for jid in ids:
+            code, rec = _get(base, f"/job/{jid}")
+            assert rec["state"] == "requeued", rec
+            assert rec["checkpoint"]
+            # The checkpoint endpoint serves the cut bytes for migration.
+            req = urllib.request.urlopen(base + f"/job/{jid}/checkpoint",
+                                         timeout=30)
+            assert req.status == 200 and len(req.read()) > 0
+    finally:
+        d.close()
+
+
+# -- cross-daemon migration (`tts migrate`) ----------------------------------
+
+
+def test_migrate_checkpoint_bit_identity(tmp_path, capsys, monkeypatch):
+    """`tts migrate`: a budgeted job cut on daemon A resumes on daemon B
+    with the REMAINING budget, and the migrated final counts are
+    bit-identical to one uninterrupted solo run of the whole budget —
+    counters are cumulative across daemons via the portable checkpoint.
+
+    TTS_PIPELINE=0 throughout (daemons AND reference): with speculation
+    the drain cut banks in-flight dispatches beyond the recorded step
+    count, so only the synchronous sequence splits exactly at a step
+    boundary."""
+    monkeypatch.setenv("TTS_PIPELINE", "0")
+    from tpu_tree_search.serve.client import migrate_main
+
+    spec = {"problem": "nqueens", "N": 12, "M": 256, "K": 64,
+            "max_steps": 6}
+    ref = _reference(N=12, M=256, K=64, max_steps=6)
+    d1 = ServeDaemon(port=0, state_dir=str(tmp_path / "a"))
+    d1.start()
+    d2 = ServeDaemon(port=0, state_dir=str(tmp_path / "b"))
+    d2.start()
+    try:
+        base1, base2 = d1.url, d2.url
+        _, sub = _post(base1, "/submit", spec)
+        jid = sub["id"]
+        _wait_state(base1, jid, "running")
+        # Deterministic mid-budget cut: drain requeues with a checkpoint.
+        d1.scheduler.drain(timeout_s=60.0)
+        code, rec = _get(base1, f"/job/{jid}")
+        assert rec["state"] == "requeued" and rec["checkpoint"], rec
+        s1 = rec["steps"]
+        assert 1 <= s1 < 6
+        port1 = int(base1.rsplit(":", 1)[1])
+        assert migrate_main(jid, base2, port=port1) == 0
+        out = capsys.readouterr().out
+        assert jid in out and "steps_done" in out
+        # Source side: consumed by the migration (cancelled, not lost).
+        code, rec = _get(base1, f"/job/{jid}")
+        assert rec["state"] == "cancelled"
+        # Destination side: one job, resumed with the remaining budget.
+        code, jobs2 = _get(base2, "/jobs")
+        assert len(jobs2) == 1
+        rec2 = _wait_final(base2, jobs2[0]["id"])
+        assert rec2["state"] == "done", rec2.get("error")
+        assert rec2["spec"]["max_steps"] == 6 - s1
+        assert rec2["steps"] == 6 - s1
+        assert _counts(rec2) == (ref.explored_tree, ref.explored_sol,
+                                 ref.best)
+        assert rec2["result"]["complete"] is False
+    finally:
+        d1.close()
+        d2.scheduler.drain(timeout_s=30.0)
+        d2.close()
+
+
+def test_migrate_done_job_refused(tmp_path, capsys):
+    """Migrating a finished job is a no-op with a clear message (rc 1),
+    and a never-run cancelled job has no checkpoint to move (rc 2)."""
+    from tpu_tree_search.serve.client import migrate_main
+
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"))
+    d.start()
+    try:
+        base = d.url
+        port = int(base.rsplit(":", 1)[1])
+        _, sub = _post(base, "/submit", NQ10K4)
+        _wait_final(base, sub["id"])
+        assert migrate_main(sub["id"], base, port=port) == 1
+        assert migrate_main("job-999999", base, port=port) == 2
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()

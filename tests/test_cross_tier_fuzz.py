@@ -9,6 +9,7 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+from tpu_tree_search.engine.batched import batched_search
 from tpu_tree_search.engine.device import device_search
 from tpu_tree_search.engine.resident import resident_search
 from tpu_tree_search.engine.sequential import sequential_search
@@ -68,12 +69,45 @@ def _fuzz_all_tiers(seed: int, lb: str):
         )
         assert res.best == opt
 
-
 @pytest.mark.parametrize(
     "seed,lb", [(11, "lb1"), (23, "lb1_d"), (47, "lb2")]
 )
 def test_all_tiers_match_sequential_on_random_instance(seed, lb):
     _fuzz_all_tiers(seed, lb)
+
+
+@pytest.mark.parametrize("seed,lb", [(11, "lb1"), (47, "lb2")])
+def test_batched_axis_matches_sequential(seed, lb):
+    """The instance-batch axis (engine/batched.py, serve --batch-slots):
+    3 identical tenants through a 2-slot batched program — slot refill
+    included — must EACH land the sequential counts on a random
+    instance; frozen-slot masking may never leak one tenant's updates
+    into another. A dedicated test (not part of _fuzz_all_tiers) so the
+    B=2 while-loop compiles once per bound family, not once per fuzz
+    parametrization."""
+    rng = np.random.default_rng(seed)
+    jobs = int(rng.integers(6, 9))
+    machines = int(rng.integers(3, 6))
+    ptm = np.ascontiguousarray(
+        rng.integers(1, 100, size=(machines, jobs)).astype(np.int32)
+    )
+
+    def mk():
+        return PFSPProblem(lb=lb, ub=0, p_times=ptm)
+
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    golden = (seq.explored_tree, seq.explored_sol)
+    for i, res in enumerate(
+        batched_search(mk(), n_jobs=3, B=2, m=4, M=64, K=8,
+                       initial_best=opt)
+    ):
+        assert (res.explored_tree, res.explored_sol) == golden, (
+            f"batched job {i} diverged on seed={seed} jobs={jobs} "
+            f"machines={machines} lb={lb}: "
+            f"{(res.explored_tree, res.explored_sol)} != {golden}"
+        )
+        assert res.best == opt
 
 
 @pytest.mark.parametrize("seed", [59, 83])

@@ -69,7 +69,7 @@ FAMILIES = ("nqueens", "pfsp-lb1", "pfsp-lb1d", "pfsp-lb2")
 def load_contracts() -> dict:
     """Import every contract-declaring module (registration side effects)
     and return the registry."""
-    from ..engine import pipeline, resident  # noqa: F401
+    from ..engine import batched, pipeline, resident  # noqa: F401
     from ..obs import counters, phases, quality  # noqa: F401
     from ..ops import compaction, pfsp_device  # noqa: F401
     from . import guard, lockorder  # noqa: F401
@@ -304,6 +304,53 @@ def audit_lb2_eval(fingerprints: dict | None = None,
                 fingerprints[key] = {
                     "ops": prim_counts(child),
                     "ops_self": prim_counts(self_),
+                }
+    return findings
+
+
+def audit_batched(fingerprints: dict | None = None,
+                  widths=(1, 2)) -> list[Finding]:
+    """The instance-batch contracts (``engine/batched.py``): B=1 jaxpr
+    byte-identity against the solo resident step, and splice-aval
+    equality (``make_slot`` leaves == the compiled step's per-slot input
+    avals) for each audited width.  Tracing only — nothing executes."""
+    import jax
+
+    from ..engine.batched import make_batched_program
+    from ..engine.resident import _make_program, resolve_capacity
+
+    factory, params = _family_factory("nqueens")
+    findings: list[Finding] = []
+    step_contracts = _contracts_for("batched-step")
+    with _pin({}):
+        problem = factory()
+        capacity, M = resolve_capacity(problem, params["M"], None)
+        dev = jax.devices()[0]
+        inner = _make_program(problem, params["m"], M, params["K"],
+                              capacity, dev)
+        state = inner.init_state({}, getattr(problem, "initial_ub", 0))
+        resident_text = str(jax.make_jaxpr(inner._step)(*state))
+        for B in widths:
+            prog = make_batched_program(problem, B, params["m"], M,
+                                        params["K"], capacity, dev)
+            args = [leaf for _ in range(B) for leaf in state]
+            jaxpr = jax.make_jaxpr(prog._step)(*args)
+            art = {
+                "B": B,
+                "b1_text": str(jaxpr) if B == 1 else None,
+                "resident_text": resident_text,
+                "slot_avals": [(tuple(s.shape), str(s.dtype))
+                               for s in prog.slot_avals()],
+                "carry_avals": [(tuple(a.shape), str(a.dtype))
+                                for a in jaxpr.in_avals],
+            }
+            key = f"batched|nqueens|B{B}"
+            for c in step_contracts:
+                findings.extend(_violations(c.name, key, c.run(art, None)))
+            if fingerprints is not None:
+                fingerprints[key] = {
+                    "ops": prim_counts(jaxpr),
+                    "outvars": len(jaxpr.jaxpr.outvars),
                 }
     return findings
 
@@ -565,6 +612,7 @@ def run_check(families=None, update: bool = False,
     if families is None:
         findings += audit_compact_ids(fingerprints)
         findings += audit_lb2_eval(fingerprints)
+        findings += audit_batched(fingerprints)
     if with_locks:
         findings += audit_locks(lock_paths)
     updated = None

@@ -296,6 +296,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="pre-warm the program pool at startup: 'serve' "
                      "(every serve-able config), 'all', or a "
                      "comma-separated config list (`tts warmup` names)")
+    srv.add_argument("--batch-slots", type=int, default=None, metavar="B",
+                     help="instance-axis batch slots per compiled program: "
+                     "when >=2 same-shape-class jobs are queued, one "
+                     "program advances up to B of them per dispatch, "
+                     "splicing/retiring jobs at dispatch boundaries with "
+                     "zero recompiles (default TTS_BATCH_SLOTS or 1 = "
+                     "today's serial path; docs/SERVING.md)")
 
     smt = sub.add_parser(
         "submit",
@@ -331,6 +338,25 @@ def build_parser() -> argparse.ArgumentParser:
     top.add_argument("--json", action="store_true", dest="top_json",
                      help="emit the composed health/jobs/classes payload "
                      "as one JSON line per refresh")
+
+    mig = sub.add_parser(
+        "migrate",
+        help="move a job between serve daemons over its portable "
+        "checkpoint: cancel-with-cut on the source, fetch "
+        "/job/<id>/checkpoint, resubmit spec+checkpoint to --to "
+        "(counters stay cumulative, so the result is bit-identical "
+        "to never having moved — docs/SERVING.md)",
+    )
+    mig.add_argument("job", type=str, help="job id on the source daemon")
+    mig.add_argument("--to", type=str, required=True, metavar="URL",
+                     help="destination daemon base URL "
+                     "(host:port or http://host:port)")
+    mig.add_argument("--port", type=int, default=_SERVE_PORT,
+                     help=f"source daemon port (default {_SERVE_PORT})")
+    mig.add_argument("--host", type=str, default="127.0.0.1",
+                     help="source daemon host (default 127.0.0.1)")
+    mig.add_argument("--json", action="store_true", dest="migrate_json",
+                     help="emit the old->new id mapping as one JSON line")
 
     wrm = sub.add_parser(
         "warmup",
@@ -945,7 +971,7 @@ def main(argv=None) -> int:
             )
         args = parser.parse_args(rest)
         if args.problem in ("lint", "check", "report", "watch", "profile",
-                            "serve", "submit", "warmup", "top"):
+                            "serve", "submit", "warmup", "top", "migrate"):
             parser.error("profile wraps a search run, not another "
                          "subcommand")
         args.phase_profile = True
@@ -987,6 +1013,12 @@ def main(argv=None) -> int:
         return top_main(port=args.port, host=args.host,
                         interval=args.interval, once=args.once,
                         as_json=args.top_json)
+    if args.problem == "migrate":
+        # Pure HTTP client of two serve daemons: no jax import.
+        from .serve.client import migrate_main
+
+        return migrate_main(args.job, args.to, port=args.port,
+                            host=args.host, as_json=args.migrate_json)
     if args.problem == "serve":
         # The daemon: jax stays out of the HTTP threads (scheduler
         # workers import the engines lazily on the first slice).
@@ -996,7 +1028,7 @@ def main(argv=None) -> int:
         return serve_main(port=args.port, host=args.host,
                           state_dir=args.state_dir, workers=args.workers,
                           quantum_s=args.quantum, max_queue=args.max_queue,
-                          warm=args.warm)
+                          warm=args.warm, batch_slots=args.batch_slots)
     if args.problem == "submit":
         # Thin client: re-parse the run command through THIS parser so
         # every CLI-side validation runs before the spec leaves the

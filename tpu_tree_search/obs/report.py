@@ -188,11 +188,24 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
             b for b in ((e.get("args") or {}).get("best") for e in disp)
             if b is not None
         ]
+        # Batched dispatches (serve/batch.py) stamp the slot index and
+        # batch width onto each dispatch span; a job that was spliced,
+        # cut, and re-admitted legitimately shows more than one slot.
+        slots = sorted({
+            s for s in ((e.get("args") or {}).get("slot") for e in disp)
+            if s is not None
+        })
+        widths = [
+            b for b in ((e.get("args") or {}).get("B") for e in disp)
+            if b is not None
+        ]
         job_lanes[j] = {
             "events": len(je),
             "dispatches": len(disp),
             "span_s": round(max(jt1 - jt0, 0.0) / 1e6, 6),
             "best": min(bests) if bests else None,
+            "slots": slots or None,
+            "batch_width": max(widths) if widths else None,
         }
 
     # -- anytime quality (obs/quality.py; incumbent + quality_ref events) --
@@ -351,6 +364,9 @@ def render(summary: dict) -> str:
                 f"{info['span_s']:.3f}s"
                 + (f", best={info['best']}"
                    if info["best"] is not None else "")
+                + (f", slot {'/'.join(str(s) for s in info['slots'])}"
+                   f" of B={info['batch_width']}"
+                   if info.get("slots") else "")
             )
     if summary.get("quality"):
         q = summary["quality"]

@@ -17,6 +17,10 @@ Layout (each module owns one concern):
   * ``scheduler.py`` — worker threads + checkpoint-based preemption
     (``RunController`` ``yield_fn`` drain -> cut -> resume, bit-identical)
     and the env-knob lease that serializes conflicting per-job pins;
+  * ``batch.py``     — the instance-axis batch executor: with
+    ``--batch-slots B`` one compiled program advances up to B same-class
+    jobs per K-cycle dispatch, splicing/retiring jobs at dispatch
+    boundaries with zero recompiles (engine/batched.py);
   * ``server.py``    — the stdlib HTTP/SSE daemon (same zero-dep pattern
     as ``obs/live.py``) and graceful SIGTERM drain;
   * ``client.py``    — ``tts submit`` / ``tts watch --job`` thin clients;
@@ -35,6 +39,6 @@ DEFAULT_PORT = 8643  # one above obs/live's default watch port
 #: (``tts_serve_build_info``) so fleet tooling can tell which daemons
 #: still need a rolling restart. Bump when the HTTP API or job-record
 #: schema changes.
-VERSION = "0.11.0"
+VERSION = "0.12.0"
 
 __all__ = ["DEFAULT_PORT", "VERSION"]

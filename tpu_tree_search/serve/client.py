@@ -278,6 +278,8 @@ def _render_top(health: dict, jobs: list, classes: dict) -> str:
         f"  queue={health.get('queue_depth', 0)}"
         f"  workers={health.get('workers_alive', '?')}"
         f"/{health.get('workers', '?')}"
+        + (f"  batch={health['batch_slots']}"
+           if int(health.get("batch_slots") or 1) > 1 else "")
         + ("" if ok else "  [DEGRADED: no alive worker]")
     )
     by_state: dict = {}
@@ -288,14 +290,19 @@ def _render_top(health: dict, jobs: list, classes: dict) -> str:
     if classes:
         lines.append("")
         lines.append(f"{'class':<44} {'warm':>4} {'progs':>5} "
-                     f"{'steps':>5} {'jobs':>5}")
+                     f"{'steps':>5} {'jobs':>5} {'slots':>5}")
         for st in sorted(classes, key=lambda st: st.get("class", "")):
+            if "slots_occupied" in st:
+                slots = f"{st['slots_occupied']}/{st.get('batch_slots', '?')}"
+            else:
+                slots = "-"
             lines.append(
                 f"{(st.get('class') or '?')[:44]:<44} "
                 f"{'y' if st.get('warm') else '-':>4} "
                 f"{st.get('programs', 0):>5} "
                 f"{st.get('step_cache_entries', 0):>5} "
-                f"{st.get('jobs_admitted', 0):>5}")
+                f"{st.get('jobs_admitted', 0):>5} "
+                f"{slots:>5}")
     active = [j for j in jobs
               if j.get("state") in ("running", "queued", "requeued")]
     finished = [j for j in jobs if j not in active]
@@ -347,3 +354,87 @@ def top_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
             time.sleep(interval)
     except KeyboardInterrupt:
         return 0
+
+
+# -- `tts migrate`: cross-daemon job migration --------------------------------
+
+
+def migrate_main(jid: str, to_url: str, port: int = DEFAULT_PORT,
+                 host: str = "127.0.0.1", as_json: bool = False,
+                 timeout_s: float = 120.0) -> int:
+    """``tts migrate <job> --to URL``: move a job between daemons over its
+    portable checkpoint. Cancel on daemon A (cutting a running slice at
+    the next dispatch boundary), fetch the checkpoint bytes, resubmit the
+    spec + checkpoint to daemon B — counters stay cumulative, so the
+    migrated run's final result is bit-identical to never having moved.
+    A consumed ``max_steps`` budget follows the job: the resubmitted spec
+    carries only the remaining steps."""
+    base = f"http://{host}:{port}"
+    dst = to_url.rstrip("/")
+    if "://" not in dst:
+        dst = "http://" + dst
+    try:
+        code, rec = _get(base + f"/job/{jid}")
+    except URLError as e:
+        print(f"Error: no serve daemon at {base}: {e}", file=sys.stderr)
+        return 2
+    if code != 200:
+        print(f"Error: unknown job {jid}", file=sys.stderr)
+        return 2
+    if rec.get("state") in ("queued", "requeued", "running"):
+        code, resp = _post(base + f"/job/{jid}/cancel", {})
+        if code not in (200, 409):
+            print(f"Error: cancel failed ({code}): {resp}", file=sys.stderr)
+            return 2
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            code, rec = _get(base + f"/job/{jid}")
+            if code == 200 and rec.get("state") in _FINAL:
+                break
+            time.sleep(0.2)
+    if rec.get("state") == "done":
+        print(f"{jid} already finished on {base}; nothing to migrate",
+              file=sys.stderr)
+        return 1
+    if not rec.get("checkpoint"):
+        print(f"Error: {jid} has no checkpoint to migrate "
+              f"(state {rec.get('state')}; it never ran to a cut)",
+              file=sys.stderr)
+        return 2
+    try:
+        with urlopen(base + f"/job/{jid}/checkpoint",  # noqa: S310
+                     timeout=30.0) as resp:
+            raw = resp.read()
+    except (URLError, OSError) as e:
+        print(f"Error: checkpoint fetch failed: {e}", file=sys.stderr)
+        return 2
+    spec = dict(rec.get("spec") or {})
+    steps = int(rec.get("steps") or 0)
+    if spec.get("max_steps") is not None:
+        remaining = int(spec["max_steps"]) - steps
+        if remaining <= 0:
+            print(f"Error: {jid} already exhausted its max_steps budget "
+                  f"({steps}/{spec['max_steps']})", file=sys.stderr)
+            return 2
+        spec["max_steps"] = remaining
+    import base64
+
+    payload = {**spec, "resume_ckpt_b64": base64.b64encode(raw).decode()}
+    try:
+        code, sub = _post(dst + "/submit", payload, timeout=60.0)
+    except URLError as e:
+        print(f"Error: no serve daemon at {dst}: {e}", file=sys.stderr)
+        return 2
+    if code != 201:
+        print(f"Error: destination rejected the migrated job ({code}): "
+              f"{sub.get('error', sub)}{_daemon_tag(dst)}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps({"from": jid, "id": sub["id"], "to": dst,
+                          "class": sub.get("class"),
+                          "warm": sub.get("warm"), "steps_done": steps}))
+    else:
+        print(f"{jid} -> {sub['id']} @ {dst}  class={sub.get('class')}"
+              f"{' (warm)' if sub.get('warm') else ''}"
+              f"  steps_done={steps}")
+    return 0

@@ -52,6 +52,10 @@ _COUNTER_HELP = {
         "Jobs pushed back to queued without preemption (drain / re-submit).",
     "tts_serve_slices_total":
         "Engine slices run, by shape class.",
+    "tts_serve_slots_spliced_total":
+        "Jobs spliced into a batch slot, by shape class.",
+    "tts_serve_slots_retired_total":
+        "Batch slots retired (finished or cut), by shape class.",
 }
 
 _HIST_HELP = {
@@ -61,6 +65,9 @@ _HIST_HELP = {
         "Per-slice engine wall time, by shape class.",
     "tts_serve_lease_wait_seconds":
         "Env-pin lease acquisition wait before a slice.",
+    "tts_serve_batch_efficiency":
+        "Live-slot fraction per batched dispatch (1.0 = full batch), "
+        "by shape class.",
 }
 
 
@@ -154,6 +161,16 @@ def render(daemon) -> str:
     _gauge(lines, "tts_serve_workers_alive",
            "Scheduler worker threads currently alive.",
            [((), daemon.scheduler.workers_alive())])
+    _gauge(lines, "tts_serve_batch_slots",
+           "Configured instance-batch slots per compiled program "
+           "(--batch-slots; 1 = batching off).",
+           [((), daemon.scheduler.batch_slots)])
+    batch = daemon.scheduler.batch_stats()  # batch lock, released
+    if batch:
+        _gauge(lines, "tts_serve_batch_slots_occupied",
+               "Batch slots currently holding a live job, by shape class.",
+               sorted(((("cls", b["class"]),), int(b["occupied"]))
+                      for b in batch))
 
     by_state: dict = {s: 0 for s in STATES}
     by_class_state: dict = {}

@@ -137,7 +137,8 @@ def compile_stats(problem) -> tuple[int, int]:
 
     progs = 0
     steps = 0
-    for attr in ("_resident_programs", "_mesh_programs"):
+    for attr in ("_resident_programs", "_mesh_programs",
+                 "_batched_programs"):
         # Snapshot: a scheduler worker may be inserting a program while a
         # stats request iterates (len+list are atomic under the GIL).
         cache = list((getattr(problem, attr, None) or {}).values())

@@ -18,10 +18,18 @@ current holders to finish their slices. With the default single worker
 the lease never blocks; it exists so ``--workers N`` stays correct.
 
 Lock order (analysis/lockorder.py audits this): no scheduler method holds
-two of {Scheduler._cv, EnvLease._cv, JobRegistry._lock,
-JobRegistry._io_lock} at once — every cross-class call happens outside
-the local ``with`` block. The registry's own ``_io_lock -> _lock``
-nesting (``JobRegistry._persist``) is the graph's only two-lock hold.
+two of {Scheduler._cv, Scheduler._batch_lock, EnvLease._cv,
+JobRegistry._lock, JobRegistry._io_lock} at once — every cross-class call
+happens outside the local ``with`` block. ``_batch_lock`` is a leaf that
+guards only the ``_batch_execs`` dict (executor lookup/create). The
+registry's own ``_io_lock -> _lock`` nesting (``JobRegistry._persist``)
+is the graph's only two-lock hold.
+
+Instance batching (``--batch-slots B`` / ``TTS_BATCH_SLOTS``, serve/
+batch.py): when B > 1 and the popped job's immediate queue neighbour
+shares its shape class, the worker runs a ``BatchExecutor`` session
+instead of a solo slice — same quantum/cancel/drain/budget semantics,
+one K-cycle dispatch advancing up to B same-class jobs at once.
 """
 
 from __future__ import annotations
@@ -79,12 +87,20 @@ class Scheduler:
 
     def __init__(self, registry, pool, workers: int = 1,
                  quantum_s: float = 5.0, state_dir: str = ".",
-                 metrics=None):
+                 metrics=None, batch_slots: int | None = None):
         self.registry = registry
         self.pool = pool
         self.workers = max(1, int(workers))
         self.quantum_s = float(quantum_s)
         self.state_dir = state_dir
+        if batch_slots is None:
+            batch_slots = int(os.environ.get("TTS_BATCH_SLOTS", "1") or 1)
+        # B=1 IS the solo path: _batchable never fires and no executor is
+        # ever built (contract batch-b1-identity pins that equivalence at
+        # the jaxpr level too).
+        self.batch_slots = max(1, int(batch_slots))
+        self._batch_lock = threading.Lock()  # leaf: guards _batch_execs
+        self._batch_execs = {}  # guarded-by: _batch_lock
         # serve/metrics.ServeMetrics (or None when embedded without a
         # daemon). Its lock is a leaf: inc/observe never call out, so
         # recording from any point here cannot invert the lock order.
@@ -196,7 +212,10 @@ class Scheduler:
             try:
                 job = self.registry.get(jid)
                 if job is not None and job.state in ("queued", "requeued"):
-                    self._run_slice(job, wid)
+                    if self._batchable(job):
+                        self._run_batch(job, wid)
+                    else:
+                        self._run_slice(job, wid)
             except Exception as e:  # noqa: BLE001 — a worker must outlive
                 # ANY per-job failure (admission, knob resolution, registry
                 # persistence, recorder setup — not just the search call):
@@ -217,6 +236,84 @@ class Scheduler:
 
     def _checkpoint_path(self, job) -> str:
         return os.path.join(self.state_dir, "jobs", f"{job.id}.ckpt.npz")
+
+    # -- instance batching (serve/batch.py) --------------------------------
+
+    def _batchable(self, job) -> bool:
+        """Route a popped job to the batch executor only when batching is
+        on, the job can occupy a fixed slot (device tier, fixed K, not
+        flagged solo-only), and the NEXT queued job shares its class —
+        batch formation follows the same front-contiguity rule as slot
+        refills, so a lone job never pays the batched program's compile."""
+        if self.batch_slots <= 1 or job.spec["tier"] != "device":
+            return False
+        if job.spec.get("K") == "auto" or \
+                (os.environ.get("TTS_K") or "").strip().lower() == "auto":
+            # AdaptiveK rebuilds the program mid-run; a fixed-B batch
+            # cannot (zero-recompile guarantee).
+            return False
+        if getattr(job, "_solo_only", False):
+            return False
+        with self._cv:
+            head = self._queue[0] if self._queue else None
+        if head is None:
+            return False
+        peer = self.registry.get(head)
+        return (peer is not None and peer.class_key == job.class_key
+                and peer.pins == job.pins)
+
+    def take_same_class_front(self, class_key: str, pins: dict,
+                              limit: int) -> list:
+        """Pop up to `limit` FRONT-CONTIGUOUS queued jobs of one shape
+        class for slot refills. Stops at the first different-class (or
+        solo-only) job: a waiter at the head must see the batch shrink,
+        not watch later same-class arrivals leapfrog it.
+
+        Lock discipline: snapshot ids under _cv, resolve via the registry
+        OUTSIDE it (no _cv -> JobRegistry._lock nesting), then remove
+        under _cv re-checking membership (a racing cancel may have
+        removed an id in between)."""
+        if limit <= 0:
+            return []
+        with self._cv:
+            if self._stopping:
+                return []
+            prefix = list(self._queue)[:limit + 8]
+        chosen = []
+        for jid in prefix:
+            job = self.registry.get(jid)
+            if job is None or job.class_key != class_key \
+                    or job.pins != pins or getattr(job, "_solo_only", False):
+                break
+            chosen.append(job)
+            if len(chosen) >= limit:
+                break
+        taken = []
+        with self._cv:
+            for job in chosen:
+                if job.id in self._queue:
+                    self._queue.remove(job.id)
+                    taken.append(job)
+        return taken
+
+    def _run_batch(self, job, wid: int) -> None:
+        key = (job.class_key, tuple(sorted(job.pins.items())))
+        with self._batch_lock:
+            ex = self._batch_execs.get(key)
+            if ex is None:
+                from .batch import BatchExecutor
+
+                ex = BatchExecutor(self, job.class_key, job.pins,
+                                   self.batch_slots)
+                self._batch_execs[key] = ex
+        ex.run(job, wid)
+
+    def batch_stats(self) -> list[dict]:
+        """Per-class batch occupancy for /metrics and `tts top`."""
+        with self._batch_lock:
+            execs = list(self._batch_execs.values())
+        return [{"class": ex.class_key, "slots": ex.B,
+                 "occupied": ex.occupied} for ex in execs]
 
     def _run_slice(self, job, wid: int) -> None:
         from ..obs import events as obs_events

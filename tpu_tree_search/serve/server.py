@@ -17,6 +17,10 @@ API (all JSON):
     reaches a terminal state (a blocking client polls or streams).
   * ``POST /job/<id>/cancel``    — cancel queued now / running at the
     next dispatch boundary; 409 when already finished.
+  * ``GET  /job/<id>/checkpoint``— the job's checkpoint as raw npz bytes
+    (409 when the job has none) — with ``resume_ckpt_b64`` on ``/submit``
+    this is the ``tts migrate`` transport: cut on daemon A, resubmit the
+    spec + checkpoint on daemon B, counters stay cumulative.
   * ``GET  /job/<id>/stream``    — SSE: one frame per new snapshot from
     the job's private flight-recorder ring (incumbent, nodes/s, pool
     occupancy ...) plus ``event: incumbent`` frames — one per recorded
@@ -66,7 +70,8 @@ class ServeDaemon:
 
     def __init__(self, port: int = DEFAULT_PORT, host: str = "127.0.0.1",
                  state_dir: str | None = None, workers: int = 1,
-                 quantum_s: float = 5.0, max_queue: int = 64):
+                 quantum_s: float = 5.0, max_queue: int = 64,
+                 batch_slots: int | None = None):
         self.state_dir = state_dir or default_state_dir()
         os.makedirs(self.state_dir, exist_ok=True)
         self.registry = JobRegistry(self.state_dir)
@@ -77,7 +82,8 @@ class ServeDaemon:
         self.scheduler = Scheduler(self.registry, self.pool, workers=workers,
                                    quantum_s=quantum_s,
                                    state_dir=self.state_dir,
-                                   metrics=self.metrics)
+                                   metrics=self.metrics,
+                                   batch_slots=batch_slots)
         self.max_queue = max_queue
         self.stop_event = threading.Event()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -107,7 +113,26 @@ class ServeDaemon:
 
     def submit(self, spec) -> tuple[dict, int]:
         """Admission: validate -> classify -> enqueue. Returns (payload,
-        http status). Runs in HTTP threads — no jax, no problem builds."""
+        http status). Runs in HTTP threads — no jax, no problem builds.
+
+        An optional top-level ``resume_ckpt_b64`` (the ``tts migrate``
+        transport) carries a checkpoint from another daemon: it is
+        decoded to a per-job file and attached BEFORE the job is
+        enqueued, so the first slice resumes from it — a worker can pop
+        the job the instant ``scheduler.submit`` returns."""
+        ckpt_b64 = None
+        if isinstance(spec, dict) and "resume_ckpt_b64" in spec:
+            spec = dict(spec)
+            ckpt_b64 = spec.pop("resume_ckpt_b64")
+            import base64
+            import binascii
+
+            try:
+                ckpt_b64 = base64.b64decode(ckpt_b64, validate=True)
+            except (TypeError, ValueError, binascii.Error):
+                self.metrics.inc("tts_serve_admissions_total",
+                                 {"outcome": "invalid"})
+                return {"error": "invalid resume_ckpt_b64"}, 400
         try:
             spec = validate_spec(spec)
         except ValueError as e:
@@ -123,6 +148,18 @@ class ServeDaemon:
 
         job = self.registry.create(spec, cls["class"], job_pins(spec),
                                    warm_hit=cls["warm"])
+        if ckpt_b64 is not None:
+            # Validity against the spec's problem is checked by the worker
+            # (engine/checkpoint.py's meta validation) — a mismatched
+            # checkpoint fails THIS job with a clear error, not the daemon.
+            jobs_dir = os.path.join(self.state_dir, "jobs")
+            os.makedirs(jobs_dir, exist_ok=True)
+            path = os.path.join(jobs_dir, f"{job.id}.resume.ckpt.npz")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(ckpt_b64)
+            os.replace(tmp, path)
+            self.registry.update(job, checkpoint=path)
         try:
             pos = self.scheduler.submit(job)
         except RuntimeError:
@@ -152,6 +189,7 @@ class ServeDaemon:
             "version": VERSION,
             "workers": self.scheduler.workers,
             "workers_alive": alive,
+            "batch_slots": self.scheduler.batch_slots,
         }
 
     def shutdown(self) -> None:
@@ -184,9 +222,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _body(self):
+    def _body(self, limit: int = 1 << 20):
         n = int(self.headers.get("Content-Length") or 0)
-        if n <= 0 or n > (1 << 20):
+        if n <= 0 or n > limit:
             return None
         try:
             return json.loads(self.rfile.read(n).decode())
@@ -202,7 +240,15 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/jobs":
                 self._json([j.record() for j in self.daemon.registry.all()])
             elif path == "/classes":
-                self._json(self.daemon.pool.stats())
+                stats = self.daemon.pool.stats()
+                batch = {b["class"]: b
+                         for b in self.daemon.scheduler.batch_stats()}
+                for st in stats:
+                    b = batch.get(st.get("class"))
+                    if b is not None:
+                        st["batch_slots"] = b["slots"]
+                        st["slots_occupied"] = b["occupied"]
+                self._json(stats)
             elif path == "/metrics":
                 body = metrics_mod.render(self.daemon).encode()
                 self.send_response(200)
@@ -229,6 +275,23 @@ class _Handler(BaseHTTPRequestHandler):
                                                 {"endpoint": "result"})
                         self._json({"error": f"job is {job.state}",
                                     "state": job.state}, code=409)
+                elif parts[3] == "checkpoint":
+                    path = job.checkpoint
+                    if not path or not os.path.exists(path):
+                        self.daemon.metrics.inc(
+                            "tts_serve_conflicts_total",
+                            {"endpoint": "checkpoint"})
+                        self._json({"error": "job has no checkpoint",
+                                    "state": job.state}, code=409)
+                    else:
+                        with open(path, "rb") as f:
+                            body = f.read()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                 elif parts[3] == "stream":
                     self._stream_job(job)
                 else:
@@ -242,7 +305,9 @@ class _Handler(BaseHTTPRequestHandler):
         path = urlparse(self.path).path
         try:
             if path == "/submit":
-                body = self._body()
+                # Larger cap than the default: a migrated submit carries a
+                # base64 checkpoint (frontier rows) in resume_ckpt_b64.
+                body = self._body(limit=64 << 20)
                 if body is None:
                     self._json({"error": "invalid JSON body"}, code=400)
                     return
@@ -316,7 +381,8 @@ class _Handler(BaseHTTPRequestHandler):
 def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
                state_dir: str | None = None, workers: int = 1,
                quantum_s: float = 5.0, max_queue: int = 64,
-               warm: str | None = None) -> int:
+               warm: str | None = None,
+               batch_slots: int | None = None) -> int:
     """The ``tts serve`` entry point: start, optionally pre-warm the pool,
     then wait for SIGTERM/SIGINT (or POST /shutdown) and drain.
 
@@ -326,7 +392,7 @@ def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
     flight-record dump and a clean drain."""
     daemon = ServeDaemon(port=port, host=host, state_dir=state_dir,
                          workers=workers, quantum_s=quantum_s,
-                         max_queue=max_queue)
+                         max_queue=max_queue, batch_slots=batch_slots)
 
     def _on_signal(signum, frame):
         # Handler context: just set the flag; the main loop drains.
@@ -342,7 +408,8 @@ def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
     print(f"Serving on {daemon.url} (v{VERSION}, "
           f"state: {daemon.state_dir}, "
           f"workers: {daemon.scheduler.workers}, "
-          f"quantum: {daemon.scheduler.quantum_s:g}s"
+          f"quantum: {daemon.scheduler.quantum_s:g}s, "
+          f"batch-slots: {daemon.scheduler.batch_slots}"
           + (f", reloaded {daemon.loaded} job record(s)" if daemon.loaded
              else "") + ")", flush=True)
     if warm is not None:
