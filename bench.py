@@ -1166,6 +1166,79 @@ def _batch_ab_rows(extras: list) -> None:
         })
 
 
+def _megakernel_ab_rows(extras: list, on_tpu: bool) -> None:
+    """One-kernel-cycle A/B (ops/megakernel.py — the keep/retire evidence
+    row, docs/HW_VALIDATION.md). Off-chip the row is a PARITY GATE only:
+    ``TTS_MEGAKERNEL=force`` arms the fused Pallas cycle in interpret mode
+    (same program structure, reference semantics) and every count must be
+    bit-identical to the off build — no timing claim, interpret wall time
+    means nothing. On TPU the row adds the timed A/B on ta014 lb1 at a
+    small-M pool-resident config (M*n inside the auto window): off vs
+    force nodes/s, speedup, and golden parity for both arms — the number
+    the round-6 keep/retire bars judge."""
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+
+    row = {"metric": "megakernel_ab"}
+    try:
+        import numpy as np
+
+        rng = np.random.default_rng(13)
+        ptm = np.ascontiguousarray(
+            rng.integers(1, 100, size=(5, 8)).astype(np.int32))
+        cases = [
+            ("nqueens", lambda: NQueensProblem(N=10)),
+            ("lb1", lambda: PFSPProblem(lb="lb1", ub=0, p_times=ptm)),
+            ("lb2", lambda: PFSPProblem(lb="lb2", ub=0, p_times=ptm)),
+        ]
+        parity = True
+        for name, mk in cases:
+            with _env_override("TTS_MEGAKERNEL", "0"):
+                off = resident_search(mk(), m=5, M=64, K=8)
+            with _env_override("TTS_MEGAKERNEL", "force"):
+                on = resident_search(mk(), m=5, M=64, K=8)
+            ok = (
+                on.megakernel == "on"
+                and (on.explored_tree, on.explored_sol, on.best)
+                == (off.explored_tree, off.explored_sol, off.best)
+            )
+            row[f"{name}_parity"] = ok
+            if not ok:
+                row[f"{name}_reason"] = on.megakernel_reason
+            parity = parity and ok
+        row["parity"] = parity
+        if on_tpu and parity:
+            prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+            timed = {}
+            for label, env in (("off", "0"), ("force", "force")):
+                with _env_override("TTS_MEGAKERNEL", env):
+                    resident_search(PFSPProblem(inst=14, lb="lb1", ub=1),
+                                    m=25, M=1024)  # warm/compile
+                    t0 = time.perf_counter()
+                    res = resident_search(
+                        PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=1024)
+                    wall = time.perf_counter() - t0
+                timed[label] = (res, wall)
+                row[f"{label}_s"] = round(wall, 3)
+                row[f"{label}_nodes_per_sec"] = round(
+                    res.explored_tree / max(wall, 1e-9), 1)
+                row[f"{label}_megakernel"] = res.megakernel
+                if res.megakernel_reason:
+                    row[f"{label}_reason"] = res.megakernel_reason
+            row["speedup"] = round(
+                timed["off"][1] / max(timed["force"][1], 1e-9), 3)
+            row["tpu_parity"] = (
+                (timed["off"][0].explored_tree, timed["off"][0].explored_sol,
+                 timed["off"][0].best)
+                == (timed["force"][0].explored_tree,
+                    timed["force"][0].explored_sol, timed["force"][0].best)
+            )
+        extras.append(row)
+    except Exception as e:  # noqa: BLE001 — A/B rows never fail a bench
+        row["error"] = f"{type(e).__name__}: {e}"
+        extras.append(row)
+
+
 def run_config(problem, m: int, M: int):
     """Warm-up run (compiles) + measured run; returns
     (result, nodes/s, elapsed, device_phase_s)."""
@@ -1386,9 +1459,16 @@ def _main(partial: BenchPartial) -> int:
             "device_phase_s": round(device_phase, 3),
             "total_s": round(elapsed, 3),
             "kernel_launches": res.diagnostics.kernel_launches,
+            # One-kernel cycle provenance: the resolved TTS_MEGAKERNEL
+            # state the headline number ran under (and, when the resolver
+            # declined/refused, why) — a banked rate is meaningless
+            # without knowing which cycle body produced it.
+            "megakernel": res.megakernel,
             "roofline": roofline(nps, prob_hl.jobs, prob_hl.machines, None,
                                  "lb1", problem=prob_hl),
         }
+        if res.megakernel_reason:
+            record["megakernel_reason"] = res.megakernel_reason
         if compact_stats is not None:
             record["compact"] = compact_stats
         # Measured kernel-only throughput on the same chunk shape: the
@@ -1476,6 +1556,10 @@ def _main(partial: BenchPartial) -> int:
         # {1, 4, 8}, bit-identity checked per job (CPU-sim, every
         # backend — the --batch-slots evidence row).
         _batch_ab_rows(extras)
+        # One-kernel-cycle A/B: interpret parity gate on every backend,
+        # timed off-vs-force ta014 lb1 rows on TPU (the keep/retire
+        # evidence, docs/HW_VALIDATION.md).
+        _megakernel_ab_rows(extras, on_tpu)
     # Published-config rate rows run in BOTH modes (bounded — a few
     # dispatches each), so any green window banks a first ta021/N16/N17
     # number automatically.

@@ -174,6 +174,46 @@ TTS_COMPACT=search timeout 1200 python scripts/headline_tune.py --quick || true
 timeout 900 python scripts/cycle_profile.py --M 1024 || true
 timeout 900 python scripts/cycle_profile.py --M 65536 --cycles 16 || true
 
+echo "== 8b/9 one-kernel cycle A/B (megakernel keep/retire evidence) =="
+# The ISSUE 13 decision row (docs/HW_VALIDATION.md keep/retire procedure):
+# ta014 lb1 at the small-M pool-resident config, off vs force, guard
+# armed — golden parity asserted inline, timed rows banked in
+# MEGAKERNEL_AB.json. A Mosaic lowering failure or a slowdown here is
+# the retire signal (the lb1-Pallas precedent); parity breakage is a bug.
+TTS_GUARD=1 timeout 900 python - <<'EOF' | tee MEGAKERNEL_AB.json \
+  || echo "MEGAKERNEL AB FAILED"
+import json, os, time
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.problems import PFSPProblem
+
+GOLDEN = None
+row = {"metric": "megakernel_ab_hw", "m": 25, "M": 1024}
+for label, knob in (("off", "0"), ("force", "force")):
+    os.environ["TTS_MEGAKERNEL"] = knob
+    resident_search(PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=1024)
+    t0 = time.perf_counter()
+    res = resident_search(PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=1024)
+    wall = time.perf_counter() - t0
+    counts = (res.explored_tree, res.explored_sol, res.best)
+    if GOLDEN is None:
+        GOLDEN = counts
+    assert counts == GOLDEN, f"{label}: {counts} != {GOLDEN}"
+    row[f"{label}_s"] = round(wall, 3)
+    row[f"{label}_nodes_per_sec"] = round(res.explored_tree / wall, 1)
+    row[f"{label}_megakernel"] = res.megakernel
+    if res.megakernel_reason:
+        row[f"{label}_reason"] = res.megakernel_reason
+row["speedup"] = round(row["off_s"] / max(row["force_s"], 1e-9), 3)
+print(json.dumps(row))
+EOF
+# Phase split of the ARMED run: the fused cycle collapses the in-cycle
+# decomposition to one eval-dominant slot — the profile row reports that
+# honestly (compact/push ~0 is the expected armed shape, not a bug).
+TTS_MEGAKERNEL=force timeout 900 python -m tpu_tree_search.cli profile pfsp \
+    --inst 14 --tier device --M 1024 --json \
+    | tee PHASES_ta014_lb1_megakernel.json \
+  || echo "TTS PROFILE (megakernel armed) FAILED"
+
 echo "== 9/9 tile sweep (per-kernel compile/throughput; informational) =="
 # Full ta014 tables were measured in the round-5 session
 # (docs/HW_VALIDATION.md); re-run is cheap with a warm cache and catches

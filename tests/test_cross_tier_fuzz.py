@@ -154,6 +154,19 @@ def test_all_tiers_match_sequential_pipeline_axis(pipeline, kmode,
     _fuzz_all_tiers(211, "lb1")
 
 
+@pytest.mark.slow  # every tier recompiles under force; CI tests-megakernel runs it unfiltered
+@pytest.mark.parametrize("seed,lb", [(173, "lb1"), (179, "lb2")])
+def test_all_tiers_match_sequential_megakernel_axis(seed, lb, monkeypatch):
+    """One-kernel cycle axis (ops/megakernel.py): with the fused Pallas
+    cycle forced (interpret mode on CPU — same program, reference
+    semantics), every tier that can arm it must land the sequential
+    counts, and the tiers that refuse (mp pair sharding, lb1_d) must
+    fall back bit-correct.  The megakernel changes WHERE the cycle runs,
+    never what it counts."""
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    _fuzz_all_tiers(seed, lb)
+
+
 @pytest.mark.parametrize("mode", ["dense", "auto"])
 def test_all_tiers_match_sequential_compact_axis(mode, monkeypatch):
     """Compaction-path axis (survivor-path overhaul): every tier — the

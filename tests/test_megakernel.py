@@ -1,0 +1,255 @@
+"""One-kernel resident cycle (ops/megakernel.py, TTS_MEGAKERNEL).
+
+Interpret-mode bit-identity of the fused pop->eval->prune->compact->push
+Pallas cycle against the fused-jnp resident across problem families,
+compact modes, checkpoint cuts, and the batched engine; the lb2
+bf16-exactness gate (bit-parity vs the f32 pair-blocked oracle on real
+Taillard instances, refusal when the gate fails); and the program-cache
+keying of the knob.  On CPU ``TTS_MEGAKERNEL=force`` arms the kernel in
+Pallas interpret mode — same program structure, reference semantics —
+so every claim here is about the real fused cycle body.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine.batched import batched_search
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.engine.sequential import sequential_search
+from tpu_tree_search.ops import megakernel as MK
+from tpu_tree_search.ops import pfsp_device as PD
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+
+
+def _ptm(seed: int, jobs: int = 7, machines: int = 5) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.ascontiguousarray(
+        rng.integers(1, 100, size=(machines, jobs)).astype(np.int32)
+    )
+
+
+def _mk_problem(family: str):
+    if family == "nqueens":
+        return lambda: NQueensProblem(N=8)
+    ptm = _ptm(311)
+    lb = {"pfsp-lb1": "lb1", "pfsp-lb2": "lb2"}[family]
+    return lambda: PFSPProblem(lb=lb, ub=0, p_times=ptm)
+
+
+def _counts(res):
+    return (res.explored_tree, res.explored_sol, res.best)
+
+
+# -- force-vs-off bit identity across the family x compact matrix ----------
+
+@pytest.mark.parametrize("family,compact", [
+    ("nqueens", "auto"),
+    ("nqueens", "dense"),
+    ("nqueens", "scatter"),
+    ("pfsp-lb1", "auto"),
+    ("pfsp-lb1", "dense"),
+    ("pfsp-lb1", "sort"),
+    ("pfsp-lb2", "auto"),
+    ("pfsp-lb2", "dense"),
+    ("pfsp-lb2", "search"),
+])
+def test_force_matches_off_bit_identical(family, compact, monkeypatch):
+    """The armed interpret-mode cycle lands the SAME explored_tree /
+    explored_sol / best as the fused-jnp resident under every survivor
+    compact mode (the off baseline varies; the fused cycle must not)."""
+    monkeypatch.setenv("TTS_COMPACT", compact)
+    mk = _mk_problem(family)
+    monkeypatch.setenv("TTS_MEGAKERNEL", "0")
+    off = resident_search(mk(), m=4, M=64, K=8)
+    assert off.megakernel == "off"
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    on = resident_search(mk(), m=4, M=64, K=8)
+    assert on.megakernel == "on", on.megakernel_reason
+    assert _counts(on) == _counts(off)
+
+
+def test_force_matches_sequential_goldens(monkeypatch):
+    """Armed counts against the host-recursion goldens directly (not just
+    the off resident) — catches an error common to both device paths."""
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    for family in ("nqueens", "pfsp-lb1", "pfsp-lb2"):
+        mk = _mk_problem(family)
+        opt = sequential_search(mk()).best
+        seq = sequential_search(mk(), initial_best=opt)
+        res = resident_search(mk(), m=4, M=64, K=8, initial_best=opt)
+        assert res.megakernel == "on", res.megakernel_reason
+        assert _counts(res) == _counts(seq)
+
+
+# -- checkpoint cuts + the batched engine ----------------------------------
+
+def _trajectory(mk, path):
+    """Cut after every dispatch (max_steps=1, K=1) and resume until done;
+    the per-slice counter trajectory is the strictest observable."""
+    out = []
+    res = resident_search(mk(), m=4, M=64, K=1, max_steps=1,
+                          checkpoint_path=path)
+    out.append(_counts(res) + (res.complete,))
+    for _ in range(300):
+        if res.complete:
+            break
+        res = resident_search(mk(), m=4, M=64, K=1, max_steps=1,
+                              resume_from=path, checkpoint_path=path)
+        out.append(_counts(res) + (res.complete,))
+    assert res.complete
+    return out
+
+
+@pytest.mark.slow  # ~70 cut/resume program slices; CI tests-megakernel runs it unfiltered
+def test_checkpoint_cut_resume_trajectory_matches(tmp_path, monkeypatch):
+    """The armed cycle composes with checkpoint cuts: the full cut/resume
+    trajectory (counters at EVERY slice boundary) is identical to the off
+    build's — the megakernel changes where the work happens, never which
+    state crosses a dispatch boundary."""
+    ptm = _ptm(631, jobs=8)
+
+    def mk():
+        return PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+
+    monkeypatch.setenv("TTS_MEGAKERNEL", "0")
+    t_off = _trajectory(mk, str(tmp_path / "off.ckpt"))
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    t_on = _trajectory(mk, str(tmp_path / "on.ckpt"))
+    assert t_on == t_off
+
+
+@pytest.mark.parametrize("lb", ["lb1", "lb2"])
+def test_batched_engine_armed_matches_sequential(lb, monkeypatch):
+    """B=2 batched program with the fused cycle armed per slot: every
+    tenant lands the sequential goldens and reports the armed state."""
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    ptm = _ptm(911)
+
+    def mk():
+        return PFSPProblem(lb=lb, ub=0, p_times=ptm)
+
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    for res in batched_search(mk(), n_jobs=3, B=2, m=4, M=64, K=8,
+                              initial_best=opt):
+        assert res.megakernel == "on", res.megakernel_reason
+        assert _counts(res) == _counts(seq)
+
+
+def test_guard_green_armed(monkeypatch):
+    """TTS_GUARD=1 runtime invariant checks stay green with the fused
+    cycle armed (a guard trip raises)."""
+    monkeypatch.setenv("TTS_GUARD", "1")
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    mk = _mk_problem("pfsp-lb1")
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    res = resident_search(mk(), m=4, M=64, K=8, initial_best=opt)
+    assert res.megakernel == "on"
+    assert _counts(res) == _counts(seq)
+
+
+# -- the lb2 bf16-exactness gate -------------------------------------------
+
+@pytest.mark.parametrize("inst", [14, 21])
+def test_lb2_bf16_mxu_bit_parity_on_taillard(inst):
+    """The max-plus MXU formulation the megakernel arms with
+    (``megakernel_lb2_bounds``, bf16 one-hot gathers) is BIT-equal to the
+    f32 pair-blocked oracle (`pfsp_device._lb2_chunk`) on ta014/ta021
+    nodes — on open slots (closed slots carry garbage both engines mask).
+    If this ever fails, `resolve`'s exactness gate is wrong and the
+    kernel must refuse to arm for the instance class."""
+    prob = PFSPProblem(inst=inst, lb="lb2", ub=1)
+    t = prob.device_tables()
+    assert t.exact_bf16  # the gate resolve() checks before arming
+    n = prob.jobs
+    rng = np.random.default_rng(5 + inst)
+    rows = 32
+    prmu = np.stack([rng.permutation(n) for _ in range(rows)]).astype(np.int32)
+    lim = rng.integers(-1, n - 2, size=rows).astype(np.int32)
+    got = np.asarray(MK.megakernel_lb2_bounds(
+        jnp.asarray(prmu), jnp.asarray(lim), t, interpret=True))
+    pb = PD.lb2_pairblock(t.pairs.shape[0], n)
+    want = np.asarray(PD._lb2_chunk(
+        jnp.asarray(prmu), jnp.asarray(lim), t.ptm_t, t.min_heads,
+        t.min_tails, t.pairs, t.lags, t.johnson_schedules, pairblock=pb))
+    open_ = np.arange(n)[None, :] > lim[:, None]
+    np.testing.assert_array_equal(got[open_], want[open_])
+
+
+def test_lb2_bf16_gate_refuses_and_falls_back(monkeypatch):
+    """Processing times >= 256 break bf16 exactness: even under force the
+    resolver refuses, the run falls back to the fused-jnp resident
+    bit-correct, and the SearchResult records why."""
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    rng = np.random.default_rng(41)
+    ptm = np.ascontiguousarray(
+        rng.integers(200, 400, size=(4, 7)).astype(np.int32))
+    ptm[0, 0] = 300  # guarantee the gate fails
+
+    def mk():
+        return PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    res = resident_search(mk(), m=4, M=64, K=8, initial_best=opt)
+    assert res.megakernel == "off"
+    assert res.megakernel_reason and "bf16" in res.megakernel_reason
+    assert _counts(res) == _counts(seq)
+
+
+def test_family_refusal_lb1d(monkeypatch):
+    """lb1_d has no in-kernel bound formulation: force refuses with a
+    recorded reason and the search still lands the goldens."""
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    ptm = _ptm(311)
+
+    def mk():
+        return PFSPProblem(lb="lb1_d", ub=0, p_times=ptm)
+
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    res = resident_search(mk(), m=4, M=64, K=8, initial_best=opt)
+    assert res.megakernel == "off"
+    assert res.megakernel_reason
+    assert _counts(res) == _counts(seq)
+
+
+# -- program-cache keying ---------------------------------------------------
+
+def test_knob_flip_rebuilds_and_reset_hits_cache(monkeypatch):
+    """TTS_MEGAKERNEL is baked into the compiled step via the routing
+    token: a flip rebuilds (distinct program objects), re-setting the
+    original value hits the cache (same object)."""
+    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
+
+    prob = NQueensProblem(N=8)
+    dev = jax.devices()[0]
+    monkeypatch.setenv("TTS_MEGAKERNEL", "0")
+    capacity, M = resolve_capacity(prob, 64, None)
+    a = _make_program(prob, 5, M, 4, capacity, dev)
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    b = _make_program(prob, 5, M, 4, capacity, dev)
+    assert a is not b
+    assert b.megakernel.enabled and not a.megakernel.enabled
+    monkeypatch.setenv("TTS_MEGAKERNEL", "0")
+    c = _make_program(prob, 5, M, 4, capacity, dev)
+    assert c is a  # cache hit — off really is the same program
+
+
+def test_resolver_refusals_record_reasons():
+    """Direct resolver checks: the correctness refusals hold even under
+    force and each records a reason string."""
+    dev = jax.devices()[0]
+    ptm = _ptm(311)
+    lb2 = PFSPProblem(lb="lb2", ub=0, p_times=ptm)
+    # mp pair-axis sharding: the fused cycle is single-shard.
+    d = MK.resolve(lb2, 64, dev, mp_axis="mp", mp_size=2)
+    assert not d.enabled and "mp" in d.reason
+    # chunk width must keep the sublane tiling exact.
+    d = MK.resolve(NQueensProblem(N=8), 60, dev)
+    assert not d.enabled and d.reason

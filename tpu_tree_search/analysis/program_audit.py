@@ -54,7 +54,7 @@ KNOBS = (
     "TTS_COMPACT", "TTS_OBS", "TTS_PHASEPROF", "TTS_LB2_PAIRBLOCK",
     "TTS_PIPELINE", "TTS_K", "TTS_GUARD", "TTS_PALLAS", "TTS_PALLAS_LB2",
     "TTS_LB2_STAGED", "TTS_XLA_TRACE", "TTS_FLIGHTREC", "TTS_COSTMODEL",
-    "TTS_QUALITY",
+    "TTS_QUALITY", "TTS_MEGAKERNEL",
 )
 
 #: Matrix axes (the lb2 families add the pair-block axis).
@@ -71,7 +71,7 @@ def load_contracts() -> dict:
     and return the registry."""
     from ..engine import batched, pipeline, resident  # noqa: F401
     from ..obs import counters, phases, quality  # noqa: F401
-    from ..ops import compaction, pfsp_device  # noqa: F401
+    from ..ops import compaction, megakernel, pfsp_device  # noqa: F401
     from . import guard, lockorder  # noqa: F401
 
     return CONTRACTS
@@ -107,12 +107,18 @@ class Cell:
     obs: str = "0"
     phaseprof: str = "0"
     pairblock: str | None = None
+    # None = knob unset (the historical matrix — keys stay byte-stable);
+    # "force" pins the one-kernel cycle (ops/megakernel.py) armed, or the
+    # refusal fallback where the family cannot arm (pfsp-lb1d).
+    megakernel: str | None = None
 
     @property
     def key(self) -> str:
         s = f"{self.family}|compact={self.compact}|obs={self.obs}|ph={self.phaseprof}"
         if self.pairblock is not None:
             s += f"|pb={self.pairblock}"
+        if self.megakernel is not None:
+            s += f"|mk={self.megakernel}"
         return s
 
     def env(self) -> dict[str, str]:
@@ -123,6 +129,8 @@ class Cell:
         }
         if self.pairblock is not None:
             e["TTS_LB2_PAIRBLOCK"] = self.pairblock
+        if self.megakernel is not None:
+            e["TTS_MEGAKERNEL"] = self.megakernel
         return e
 
 
@@ -161,6 +169,15 @@ def matrix_cells(families=None, compact=None, obs=None, phaseprof=None,
                 for ph in phaseprof or PHASEPROF_AXIS:
                     for pb in pbs:
                         out.append(Cell(fam, c, o, ph, pb))
+        # One-kernel cycle axis (TTS_MEGAKERNEL=force): compact stays
+        # auto (the fused cycle subsumes the survivor path), pairblock
+        # stays auto on lb2; pfsp-lb1d pins the REFUSAL fallback — the
+        # megakernel-single-call contract asserts a recorded reason and
+        # zero pallas_calls there.
+        pb = "auto" if fam == "pfsp-lb2" else None
+        for o in obs or OBS_AXIS:
+            for ph in phaseprof or PHASEPROF_AXIS:
+                out.append(Cell(fam, "auto", o, ph, pb, megakernel="force"))
     return out
 
 
@@ -371,6 +388,7 @@ VARIANT_ENVS = {
     "pipe2": {"TTS_PIPELINE": "2"},
     "guard1": {"TTS_GUARD": "1"},
     "quality1": {"TTS_QUALITY": "1"},
+    "mk0": {"TTS_MEGAKERNEL": "0"},
 }
 
 
@@ -446,6 +464,14 @@ def cache_key_artifact(family: str) -> CacheKeyArtifact:
         "TTS_COMPACT": (p0, build({**base, "TTS_COMPACT": "search"})),
         "TTS_OBS": (p0, build({**base, "TTS_OBS": "1"})),
         "TTS_PHASEPROF": (p0, build({**base, "TTS_PHASEPROF": "1"})),
+        # The one-kernel cycle is baked into the step (and into the
+        # routing token even when the resolver refuses), so a knob flip
+        # must rebuild — a stale cached off-program under force (or vice
+        # versa) would silently run the wrong cycle body.
+        "TTS_MEGAKERNEL": (
+            build({**base, "TTS_MEGAKERNEL": "0"}),
+            build({**base, "TTS_MEGAKERNEL": "force"}),
+        ),
     }
     if family == "pfsp-lb2":
         distinct["TTS_LB2_PAIRBLOCK"] = (

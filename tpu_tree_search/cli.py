@@ -690,6 +690,11 @@ def print_settings(args) -> None:
 
         knob = args.compact or os.environ.get("TTS_COMPACT", "auto")
         print(f"Survivor path (TTS_COMPACT): {knob}")
+        # Raw one-kernel-cycle knob; the RESOLVED state (auto arms per
+        # device/shape/VMEM fit, refusals record why) is printed with the
+        # results and recorded in the stats line.
+        mknob = os.environ.get("TTS_MEGAKERNEL", "auto") or "auto"
+        print(f"One-kernel cycle (TTS_MEGAKERNEL): {mknob}")
         # Raw dispatch-pipeline knobs; the RESOLVED depth/K are printed
         # with the results (auto may resize K along the ladder mid-run).
         pknob = os.environ.get("TTS_PIPELINE", "auto") or "auto"
@@ -738,6 +743,10 @@ def print_results(args, problem, res) -> None:
     if res.compact:
         tag = " (auto)" if res.compact_auto else ""
         print(f"Survivor path: {res.compact}{tag}")
+    if res.megakernel:
+        tag = " (auto)" if res.megakernel_auto else ""
+        why = f" — {res.megakernel_reason}" if res.megakernel_reason else ""
+        print(f"One-kernel cycle: {res.megakernel}{tag}{why}")
     if res.k_resolved is not None:
         tag = " (auto)" if res.k_auto else ""
         print(f"Dispatch pipeline: depth={res.pipeline_depth}, "
@@ -852,6 +861,16 @@ def result_record(args, res) -> dict:
                 rec["k"] = res.k_resolved
             if res.k_auto:
                 rec["k_auto"] = True
+            # The RESOLVED one-kernel-cycle state (engine-surfaced, like
+            # "compact") — a stats line must prove whether the fused cycle
+            # or the op-chain produced the number, and a refusal must say
+            # why it fell back.
+            if res.megakernel is not None:
+                rec["megakernel"] = res.megakernel
+                if res.megakernel_auto:
+                    rec["megakernel_auto"] = True
+                if res.megakernel_reason:
+                    rec["megakernel_reason"] = res.megakernel_reason
         if args.problem == "pfsp" and args.lb == "lb2":
             # Staging applies at every mp: under mp > 1 the compacted self
             # bound shards its pair loop with a pmax combine. The job count

@@ -346,6 +346,9 @@ def batched_search(
                     complete=True,
                     compact=prog.inner.compact,
                     compact_auto=prog.inner.compact_auto,
+                    megakernel=prog.inner.megakernel.state,
+                    megakernel_auto=prog.inner.megakernel.auto,
+                    megakernel_reason=prog.inner.megakernel.reason,
                     k_resolved=prog.K,
                     obs=({"device_counters": sl["ctr"]}
                          if sl.get("ctr") is not None else None),

@@ -155,6 +155,17 @@ class _ResidentProgram:
         # separate cache-keyed program variant for `tts profile` — when
         # off, nothing below is traced and the jaxpr is byte-identical.
         self.phaseprof = obs_phases.phase_profiling_enabled()
+        # One-kernel cycle (TTS_MEGAKERNEL, ops/megakernel.py): the whole
+        # pop->bound->prune->compact->push cycle as a single pallas_call,
+        # resolved once at build time like the compact auto policy (TPU +
+        # small-M + VMEM fit; correctness refusals recorded in
+        # .megakernel.reason for the banner/SearchResult). The raw knob
+        # rides routing_cache_token, so a flip rebuilds; when off, nothing
+        # in loop_fns traces differently (contract megakernel-off-identity).
+        from ..ops import megakernel as MK
+
+        self.megakernel = MK.resolve(problem, M, self.device,
+                                     mp_axis=mp_axis, mp_size=mp_size)
         self._step = self._build()
 
     def loop_fns(self, K: int | None = None):
@@ -185,6 +196,12 @@ class _ResidentProgram:
         aux_dt = self.pool_fields[1][1]
         evaluate = self._make_eval()
         swap_of = self._swap_pos
+        mk_cycle = None
+        if self.megakernel.enabled:
+            from ..ops import megakernel as MK
+
+            mk_cycle = MK.make_cycle(self.problem, M, self.device,
+                                     self.megakernel)
 
         # tts-lint: traced (returned to lax.while_loop via loop_fns)
         def body(carry):
@@ -212,6 +229,46 @@ class _ResidentProgram:
                 ph, (vals8_c, vals_c, aux_c, size, valid) = obs_phases.boundary(
                     ph, "pop", vals8_c, vals_c, aux_c, size, valid
                 )
+
+            if mk_cycle is not None:
+                # Armed one-kernel cycle (ops/megakernel.py): bound + prune
+                # + shift-compact + emit run inside ONE pallas_call; the
+                # engine only writes the compacted rows back into the
+                # reserved Mn headroom (rows past tree_inc are dead by the
+                # pool contract). The phase profiler reports the collapse
+                # honestly: everything lands in `eval`, and the
+                # pop+eval+...+overflow == total telescope still holds.
+                rows_mk, aux_mk, tree_inc, sol_inc, best = mk_cycle(
+                    vals_c, aux_c, valid, best
+                )
+                fits = tree_inc <= S  # survivor-budget overflow counter
+                pool_vals = lax.dynamic_update_slice(
+                    pool_vals, rows_mk.astype(vals_dt), (size, jnp.int32(0))
+                )
+                pool_aux = lax.dynamic_update_slice(
+                    pool_aux, aux_mk.astype(aux_dt), (size,)
+                )
+                size = size + tree_inc
+                if phaseprof:
+                    ph, (pool_vals, pool_aux, size) = obs_phases.boundary(
+                        ph, "eval", pool_vals, pool_aux, size
+                    )
+                    ph = obs_phases.close_total(ph, t_cycle0)
+                out = (
+                    pool_vals, pool_aux, size, best,
+                    tree + tree_inc, sol + sol_inc, cycles + 1,
+                )
+                if obs:
+                    # push_rows: the megakernel always shift-compacts the
+                    # whole Mn reservation.
+                    ctr = obs_counters.update(
+                        ctr, cnt, n, tree_inc, sol_inc, fits, size,
+                        jnp.int32(Mn),
+                    )
+                    out = out + (ctr,)
+                if phaseprof:
+                    out = out + (ph,)
+                return out
 
             keep, sol_inc, best = evaluate(vals_c, aux_c, valid, best)
             d = swap_of(aux_c)  # (M,) swap position per parent
@@ -944,6 +1001,9 @@ def resident_search(
                 steps=controller.steps,
                 compact=program.compact,
                 compact_auto=program.compact_auto,
+                megakernel=program.megakernel.state,
+                megakernel_auto=program.megakernel.auto,
+                megakernel_reason=program.megakernel.reason,
                 pipeline_depth=depth,
                 k_resolved=program.K,
                 k_auto=k_auto,
@@ -1035,6 +1095,9 @@ def resident_search(
         steps=controller.steps,
         compact=program.compact,
         compact_auto=program.compact_auto,
+        megakernel=program.megakernel.state,
+        megakernel_auto=program.megakernel.auto,
+        megakernel_reason=program.megakernel.reason,
         pipeline_depth=depth,
         k_resolved=program.K,
         k_auto=k_auto,

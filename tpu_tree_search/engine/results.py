@@ -66,6 +66,14 @@ class SearchResult:
     # None for tiers that prune on host and never compact.
     compact: str | None = None
     compact_auto: bool = False
+    # Resident tiers: the one-kernel cycle state the compiled step baked
+    # in (TTS_MEGAKERNEL, ops/megakernel.py) — "on"/"off", with
+    # megakernel_auto True when the auto policy decided and, when the
+    # kernel refused to arm (or auto declined), the recorded reason.
+    # None for tiers without a resident program.
+    megakernel: str | None = None
+    megakernel_auto: bool = False
+    megakernel_reason: str | None = None
     # Resident tiers: dispatch-pipeline depth the host loop ran with
     # (TTS_PIPELINE — 1 = synchronous, >= 2 = speculative), the K the
     # loop ended on, and whether TTS_K=auto resolved it (engine/pipeline.py).

@@ -1,0 +1,623 @@
+"""One-kernel resident cycle: a Pallas megakernel for the small-M regime.
+
+The resident engine's inner loop (pop -> bound -> prune -> compact -> push,
+the offload cycle of `pfsp_gpu_chpl.chpl:276-298`) normally compiles as a
+chain of XLA ops inside the `lax.while_loop`: each op boundary is a
+dispatch, and every intermediate (the child cube, the keep plane, the
+compacted rows) round-trips through HBM.  At the headline shapes (M around
+1024) `tts profile` shows the cycle is dominated by exactly those
+boundaries.  This module fuses the whole cycle into a SINGLE `pallas_call`:
+the popped tile enters VMEM once, bounds are evaluated with the same tile
+math as the standalone kernels (`_nqueens_tile_labels` / `_lb1_tile_lb` /
+`_lb2_tile_lb` in `ops/pallas_kernels.py` — shared helpers, so the bound
+values are the already-pinned-exact kernel values), pruning, the LSB-first
+binary-shift survivor compaction of `ops/compaction.shift_compact`, and the
+push all happen against that same resident tile, and only the compacted
+child rows leave.
+
+Exactness:
+
+* survivor ranks are triangular MXU matmuls over the 0/1 keep plane at
+  HIGHEST precision — counts are < 2^24, so f32 accumulation is exact;
+* lb1 is the int32 chain of `_lb1_tile_lb` (bit-exact vs `_lb1_chunk` on
+  open slots);
+* lb2 rides the max-plus closed form as bf16 MXU matmuls and is only
+  allowed to arm when the instance passes the bf16-exactness gate
+  (`PFSPDeviceTables.exact_bf16`: every processing time < 2^8, so every
+  matmul operand is exactly representable in bf16) — otherwise
+  :func:`resolve` refuses and records why (banner + SearchResult).
+
+Routing (`TTS_MEGAKERNEL=auto|0|force`, resolved like the compact auto
+policy): ``auto`` arms only on a real TPU backend, in the small-M window,
+and when the VMEM model fits — the megakernel's batch tile IS the chunk
+width M (grid=(1,), the pool tile stays resident across the whole cycle),
+so unlike the standalone kernels there is no `_auto_tile` shrinking: the
+pool-resident buffers are charged into `_model_bytes` as ``extra_bytes``
+and a shape that does not fit is REFUSED, never tiled down.  ``force``
+arms everywhere (interpret mode off-TPU — the CI/CPU parity spelling).
+The raw knob is keyed into `routing_cache_token`, so a flip rebuilds the
+resident program and ``0`` is a byte-identical jaxpr (contract
+`megakernel-off-identity`).
+
+Keep/retire: the lb1 Pallas kernel lost 7x to fused jnp and was demoted
+(docs/HW_VALIDATION.md) — this kernel ships with the same decision
+procedure (docs/HW_VALIDATION.md "Megakernel keep/retire",
+`hw_session.sh` stage 8): it either beats the measured phase split on chip
+or dies quickly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..analysis.contracts import contract
+from . import pallas_kernels as PK
+
+#: auto refuses above this M*n product — beyond the small-M regime the
+#: compacted write-back dominates and the fused cycle has no dispatch
+#: overhead left to amortize (same window as the dense-compact policy).
+SMALL_M_LIMIT = 1 << 16
+
+#: mirrors problems.base.INF_BOUND without importing the problems package
+#: into a kernel module (the packages import each other lazily).
+_INF_BOUND = 2**31 - 1
+
+
+def megakernel_mode() -> str:
+    """The TTS_MEGAKERNEL knob: ``auto`` (default — TPU + small-M + VMEM
+    fit), ``0`` (off, byte-identical jaxpr), ``force`` (arm everywhere;
+    interpret mode off-TPU)."""
+    mode = os.environ.get("TTS_MEGAKERNEL", "auto")
+    if mode not in ("auto", "0", "force"):
+        raise ValueError(
+            f"TTS_MEGAKERNEL must be auto|0|force, got {mode!r}"
+        )
+    return mode
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """The resolved megakernel routing for one resident program build.
+
+    ``reason`` records why the kernel did NOT arm (auto declined, or a
+    correctness refusal that even ``force`` honors) — surfaced in the
+    `tts` banner and carried in SearchResult.megakernel_reason."""
+
+    enabled: bool
+    auto: bool
+    interpret: bool
+    reason: str | None
+
+    @property
+    def state(self) -> str:
+        return "on" if self.enabled else "off"
+
+
+def _family(problem) -> str | None:
+    name = getattr(problem, "name", None)
+    if name == "nqueens":
+        return "nqueens"
+    if name == "pfsp":
+        return getattr(problem, "lb", None)
+    return None
+
+
+def _on_tpu(device) -> bool:
+    try:
+        if device is not None:
+            return getattr(device, "platform", None) == "tpu"
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _mega_pool_bytes(M: int, n: int) -> int:
+    """The pool-resident VMEM charge of the fused cycle at chunk width M —
+    the ``extra_bytes`` the feasibility gate adds on top of the bound
+    kernels' own `_model_bytes` model.  Unlike the standalone kernels the
+    batch tile here IS M (grid=(1,)), so these buffers cannot be tiled
+    away: the child cube, the flattened (M*n, n) child rows plus the shift
+    pass's live copies, the rank/dist columns, and the two triangular rank
+    operands are all live inside one grid step."""
+    r8, r128 = PK._r8, PK._r128
+    Mn = M * n
+    cube = M * r8(n) * r128(n) * 4          # (M, n, n) child cube
+    flat = 3 * r8(Mn) * r128(n) * 4         # (Mn, n) rows + shift copies
+    cols = 4 * r8(Mn) * 128 * 4             # aux/rank/dist/take columns
+    tri = r8(M) * r128(M) * 4 + r8(n) * r128(n) * 4  # rank triangles
+    io = 3 * r8(M) * r128(n) * 4 + 128 * 4  # popped tile, keep, scalars
+    return cube + flat + cols + tri + io
+
+
+def _fits(problem, fam: str, M: int, n: int) -> tuple[bool, str | None]:
+    """VMEM feasibility at the fixed tile M (no `_auto_tile` shrinking —
+    see `_mega_pool_bytes`)."""
+    extra = _mega_pool_bytes(M, n)
+    if fam == "nqueens":
+        need = PK._model_bytes(M, n, 1, extra, 3)
+    elif fam == "lb1":
+        need = PK._model_bytes(M, n, problem.machines, extra, 3)
+    else:  # lb2
+        from . import pfsp_device as PD
+
+        m = problem.machines
+        P = problem.lb2_data.pairs.shape[0]
+        pg = PD.lb2_kernel_pair_group(P, n)
+        need = PK._model_bytes(
+            M, n, m, extra + PK._lb2_static_extra(n, m, P + (-P) % pg), 3,
+            pair_copies=5, pair_group=pg,
+        )
+    budget = PK._vmem_budget()
+    if need > budget:
+        return False, (
+            f"auto: VMEM model {need // 2**20} MiB exceeds the "
+            f"{budget // 2**20} MiB budget at M={M} (the cycle tile is the "
+            "chunk width — the pool-resident charge cannot be tiled down)"
+        )
+    return True, None
+
+
+def resolve(problem, M: int, device=None, mp_axis: str | None = None,
+            mp_size: int = 1) -> Decision:
+    """Resolve the megakernel routing for one resident program build —
+    the `_auto_compact`-style policy.  Correctness refusals (unsupported
+    bound family, mp pair sharding, the lb2 bf16-exactness gate, tile
+    misalignment) hold even under ``force``; the remaining gates (real
+    TPU, small-M window, VMEM fit) apply to ``auto`` only."""
+    mode = megakernel_mode()
+    if mode == "0":
+        return Decision(False, False, False, None)
+    auto = mode == "auto"
+    fam = _family(problem)
+    n = int(problem.child_slots)
+    if fam not in ("nqueens", "lb1", "lb2"):
+        return Decision(False, auto, False,
+                        f"unsupported bound family {fam!r} (the megakernel "
+                        "ports nqueens/lb1/lb2 only)")
+    if mp_axis is not None or mp_size > 1:
+        return Decision(False, auto, False,
+                        "mp pair-axis sharding (the fused cycle is "
+                        "single-shard)")
+    if M % 8 != 0:
+        return Decision(False, auto, False,
+                        f"M={M} not a multiple of the sublane quantum (8)")
+    if fam == "lb2":
+        t = problem.device_tables()
+        if not getattr(t, "exact_bf16", False):
+            return Decision(False, auto, False,
+                            "lb2 bf16-exactness gate: max processing time "
+                            ">= 256, the max-plus MXU formulation is not "
+                            "bit-exact (f32 pair-blocked oracle keeps the "
+                            "cycle)")
+    if not auto:
+        interpret = PK.pallas_interpret() or not _on_tpu(device)
+        return Decision(True, False, interpret, None)
+    if not _on_tpu(device) or PK.pallas_interpret():
+        return Decision(False, True, False, "auto: not on a TPU backend")
+    if M * n > SMALL_M_LIMIT:
+        return Decision(False, True, False,
+                        f"auto: M*n={M * n} above the small-M window "
+                        f"({SMALL_M_LIMIT})")
+    ok, why = _fits(problem, fam, M, n)
+    if not ok:
+        return Decision(False, True, False, why)
+    return Decision(True, True, False, None)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel cycle epilogue: prune -> rank -> shift-compact -> emit
+# ---------------------------------------------------------------------------
+
+
+def _scalar_lanes(tree_inc, sol_inc, best):
+    """(1, 128) int32 scalar output row: lanes 0/1/2 = tree_inc / sol_inc /
+    best (Mosaic wants a full lane register, not three scalars)."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+    return jnp.where(
+        lane == 0, tree_inc,
+        jnp.where(lane == 1, sol_inc, jnp.where(lane == 2, best, 0)),
+    )
+
+
+def _compact_push(vals, aux, d, keep, *, n: int, M: int):
+    """Survivor compaction entirely in VMEM: ranks as triangular MXU
+    matmuls, children as the three-select swap cube (`_swap_children`'s
+    structure — no gather), then the LSB-first binary-shift scheme of
+    `ops/compaction.shift_compact`, statically unrolled over the flattened
+    (M*n, *) payloads.  Returns (rows (Mn, n) i32, caux (Mn, 1) i32,
+    tree_inc) with rows beyond ``tree_inc`` garbage (dead by the pool
+    contract — the engine advances ``size`` by tree_inc only)."""
+    i32, f32 = jnp.int32, jnp.float32
+    Mn = M * n
+    keep_f = keep.astype(f32)  # (M, n)
+
+    # Exclusive prefix counts: within-row along lanes (keep @ strict-upper
+    # triangle) and across rows (strict-lower triangle @ per-row counts).
+    # 0/1 x 0/1 matmuls at HIGHEST precision; every count < 2^24 -> exact.
+    rl = jax.lax.broadcasted_iota(i32, (n, n), 0)
+    cl = jax.lax.broadcasted_iota(i32, (n, n), 1)
+    lane = PK._hp_dot(keep_f, (rl < cl).astype(f32))  # (M, n)
+    cnt = jnp.sum(keep_f, axis=1, keepdims=True)  # (M, 1)
+    rm = jax.lax.broadcasted_iota(i32, (M, M), 0)
+    cm = jax.lax.broadcasted_iota(i32, (M, M), 1)
+    offs = PK._hp_dot((cm < rm).astype(f32), cnt)  # (M, 1)
+    ranks = (offs + lane).astype(i32)  # (M, n) row-major survivor ranks
+    tree_inc = jnp.sum(keep, dtype=i32)
+
+    # Child cube by pure selects (a child differs from its parent at
+    # exactly the two swapped positions); the value at the swap position
+    # comes out of a one-hot lane reduction — no gather in the kernel.
+    iota_l = jax.lax.broadcasted_iota(i32, (M, n, n), 2)
+    kcol = jax.lax.broadcasted_iota(i32, (M, n, n), 1)
+    ohd = jax.lax.broadcasted_iota(i32, (M, n), 1) == d[:, None]
+    v_d = jnp.sum(jnp.where(ohd, vals, 0), axis=1)  # (M,) value at pos d
+    cube = jnp.where(
+        iota_l == d[:, None, None], vals[:, :, None],
+        jnp.where(iota_l == kcol, v_d[:, None, None], vals[:, None, :]),
+    )
+    rows = cube.reshape(Mn, n)
+    caux = jnp.broadcast_to((aux + 1)[:, None, None], (M, n, 1)).reshape(Mn, 1)
+    keep_col = keep[:, :, None].reshape(Mn, 1)
+    ranks_col = ranks[:, :, None].reshape(Mn, 1)
+    idx_col = jax.lax.broadcasted_iota(i32, (Mn, 1), 0)
+    dist = jnp.where(keep_col, idx_col - ranks_col, 0)
+
+    # LSB-first binary shift (`ops/compaction.shift_compact`), statically
+    # unrolled: distances only lose set bits, so log2(Mn) masked
+    # shift-by-2^b rounds land every survivor at its rank.
+    for b in range(max(1, int(Mn - 1).bit_length())):
+        s = 1 << b
+        if s >= Mn:
+            break
+        zc = jnp.zeros((s, 1), i32)
+        sh_d = jnp.concatenate([dist[s:], zc], axis=0)
+        take = (sh_d & s) != 0
+        moving = (dist & s) != 0
+        rows = jnp.where(take, jnp.concatenate(
+            [rows[s:], jnp.zeros((s, n), i32)], axis=0), rows)
+        caux = jnp.where(take, jnp.concatenate([caux[s:], zc], axis=0), caux)
+        dist = jnp.where(take, sh_d - s, jnp.where(moving, 0, dist))
+    return rows, caux, tree_inc
+
+
+def _pfsp_epilogue(prmu, limit1, valid, best, lb, *, n: int, M: int):
+    """The `_PFSPResident` evaluate fold (open/leaf/incumbent/keep — the
+    unstaged branch; see the staged-equivalence note in `make_cycle`) +
+    compaction.  ``lb`` int32 per child slot; swap position and child
+    limit1 are both ``limit1 + 1``."""
+    i32 = jnp.int32
+    pdepth = limit1 + 1
+    kk = jax.lax.broadcasted_iota(i32, (M, n), 1)
+    open_ = (kk >= pdepth[:, None]) & valid[:, None]
+    leaf = open_ & ((pdepth[:, None] + 1) == n)
+    sol_inc = jnp.sum(leaf, dtype=i32)
+    best = jnp.minimum(best, jnp.min(jnp.where(leaf, lb, i32(_INF_BOUND))))
+    keep = open_ & (~leaf) & (lb < best)
+    rows, caux, tree_inc = _compact_push(prmu, limit1, pdepth, keep, n=n, M=M)
+    return rows, caux, tree_inc, sol_inc, best
+
+
+# ---------------------------------------------------------------------------
+# family cycle kernels
+# ---------------------------------------------------------------------------
+
+
+def _mega_nqueens_kernel(board_ref, depth_ref, valid_ref, best_ref,
+                         out_vals_ref, out_aux_ref, scal_ref,
+                         *, N: int, g: int, M: int):
+    board = board_ref[:].astype(jnp.int32)  # (M, N)
+    depth = depth_ref[:, 0].astype(jnp.int32)  # (M,)
+    valid = valid_ref[:, 0] != 0
+    best = best_ref[0]
+    labels = PK._nqueens_tile_labels(board, depth, N=N, g=g)
+    # The `_NQueensResident` evaluate fold: swap position is the depth.
+    keep = labels & valid[:, None] & (depth < N)[:, None]
+    sol_inc = jnp.sum(valid & (depth == N), dtype=jnp.int32)
+    rows, caux, tree_inc = _compact_push(board, depth, depth, keep, n=N, M=M)
+    out_vals_ref[:] = rows
+    out_aux_ref[:] = caux
+    scal_ref[:] = _scalar_lanes(tree_inc, sol_inc, best)
+
+
+def _mega_lb1_kernel(prmu_ref, limit1_ref, valid_ref, best_ref,
+                     ptm_ref, heads_ref, tails_ref,
+                     out_vals_ref, out_aux_ref, scal_ref, scan_ref,
+                     *, n: int, m: int, M: int, bf16: bool):
+    prmu = prmu_ref[:].astype(jnp.int32)
+    limit1 = limit1_ref[:, 0].astype(jnp.int32)
+    valid = valid_ref[:, 0] != 0
+    best = best_ref[0]
+    ptm = ptm_ref[:].astype(jnp.float32)
+    lb = PK._lb1_tile_lb(prmu, limit1, ptm, heads_ref[:], tails_ref[:],
+                         scan_ref, n=n, m=m, bf16=bf16)
+    rows, caux, tree_inc, sol_inc, best = _pfsp_epilogue(
+        prmu, limit1, valid, best, lb, n=n, M=M)
+    out_vals_ref[:] = rows
+    out_aux_ref[:] = caux
+    scal_ref[:] = _scalar_lanes(tree_inc, sol_inc, best)
+
+
+def _mega_lb2_kernel(prmu_ref, limit1_ref, valid_ref, best_ref,
+                     ptm_ref, heads_ref,
+                     p0_ref, p1_ref, lag_ref, t0_ref, t1_ref,
+                     msel0_ref, msel1_ref, jorder_ref,
+                     out_vals_ref, out_aux_ref, scal_ref, scan_ref,
+                     *, n: int, m: int, P: int, M: int, pg: int, bf16: bool):
+    prmu = prmu_ref[:].astype(jnp.int32)
+    limit1 = limit1_ref[:, 0].astype(jnp.int32)
+    valid = valid_ref[:, 0] != 0
+    best = best_ref[0]
+    ptm = ptm_ref[:].astype(jnp.float32)
+    lb = PK._lb2_tile_lb(
+        prmu, limit1, ptm, heads_ref[:],
+        p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref,
+        jorder_ref, scan_ref, n=n, m=m, P=P, pg=pg, bf16=bf16,
+    ).astype(jnp.int32)
+    rows, caux, tree_inc, sol_inc, best = _pfsp_epilogue(
+        prmu, limit1, valid, best, lb, n=n, M=M)
+    out_vals_ref[:] = rows
+    out_aux_ref[:] = caux
+    scal_ref[:] = _scalar_lanes(tree_inc, sol_inc, best)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call factories (grid=(1,) — the pool tile IS the grid)
+# ---------------------------------------------------------------------------
+
+
+def _cycle_out(M: int, n: int):
+    Mn = M * n
+    shapes = (
+        jax.ShapeDtypeStruct((Mn, n), jnp.int32),
+        jax.ShapeDtypeStruct((Mn, 1), jnp.int32),
+        jax.ShapeDtypeStruct((1, 128), jnp.int32),
+    )
+    specs = (
+        pl.BlockSpec((Mn, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((Mn, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 128), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    )
+    return shapes, specs
+
+
+def _chunk_specs(M: int, n: int):
+    full = lambda i: (0, 0)
+    return [
+        pl.BlockSpec((M, n), full, memory_space=pltpu.VMEM),   # vals
+        pl.BlockSpec((M, 1), full, memory_space=pltpu.VMEM),   # aux
+        pl.BlockSpec((M, 1), full, memory_space=pltpu.VMEM),   # valid
+        pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),  # best
+    ]
+
+
+@lru_cache(maxsize=None)
+def _nqueens_cycle_call(N: int, g: int, M: int, interpret: bool):
+    shapes, out_specs = _cycle_out(M, N)
+    return pl.pallas_call(
+        partial(_mega_nqueens_kernel, N=N, g=g, M=M),
+        out_shape=shapes,
+        grid=(1,),
+        in_specs=_chunk_specs(M, N),
+        out_specs=out_specs,
+        compiler_params=PK._compiler_params(),
+        interpret=interpret,
+    )
+
+
+@lru_cache(maxsize=None)
+def _lb1_cycle_call(n: int, m: int, M: int, bf16: bool, interpret: bool):
+    full = lambda i: (0, 0)
+    shapes, out_specs = _cycle_out(M, n)
+    return pl.pallas_call(
+        partial(_mega_lb1_kernel, n=n, m=m, M=M, bf16=bf16),
+        out_shape=shapes,
+        grid=(1,),
+        in_specs=_chunk_specs(M, n) + [
+            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM((n, M, m), jnp.int32)],
+        compiler_params=PK._compiler_params(),
+        interpret=interpret,
+    )
+
+
+@lru_cache(maxsize=None)
+def _lb2_cycle_call(n: int, m: int, P: int, M: int, pg: int, bf16: bool,
+                    interpret: bool):
+    full = lambda i: (0, 0)
+    full3 = lambda i: (0, 0, 0)
+    shapes, out_specs = _cycle_out(M, n)
+    return pl.pallas_call(
+        partial(_mega_lb2_kernel, n=n, m=m, P=P, M=M, pg=pg, bf16=bf16),
+        out_shape=shapes,
+        grid=(1,),
+        in_specs=_chunk_specs(M, n) + [
+            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
+            # Per-pair table layout matches `_lb2_call` exactly — see the
+            # leading-axis / SMEM notes there.
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, n, n), full3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        scratch_shapes=[pltpu.VMEM((n, M, m), jnp.int32)],
+        compiler_params=PK._compiler_params(),
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# engine entry
+# ---------------------------------------------------------------------------
+
+
+def make_cycle(problem, M: int, device, decision: Decision):
+    """Build ``cycle(vals_c, aux_c, valid, best) -> (rows (Mn, n) i32,
+    caux (Mn,) i32, tree_inc, sol_inc, best)`` — the armed alternate body
+    `engine/resident.py loop_fns` splices in after the pop.
+
+    lb2 note: the kernel always evaluates the UNSTAGED fold, even when the
+    two-pass staged evaluator is enabled for the jnp path.  They are
+    value-identical: at a leaf the lb1 and lb2 makespans coincide (nothing
+    is unscheduled), and for interior nodes ``lb2 >= lb1`` pointwise, so
+    the staged keep ``open & ~leaf & (lb1 < best) & (lb2 < best)``
+    equals the unstaged ``open & ~leaf & (lb2 < best)``.
+    """
+    fam = _family(problem)
+    interpret = decision.interpret
+    if fam == "nqueens":
+        call = _nqueens_cycle_call(problem.N, problem.g, M, interpret)
+
+        def cycle(vals_c, aux_c, valid, best):
+            rows, caux, scal = call(
+                vals_c, aux_c[:, None], valid.astype(jnp.int32)[:, None],
+                jnp.reshape(best, (1,)),
+            )
+            return rows, caux[:, 0], scal[0, 0], scal[0, 1], scal[0, 2]
+
+        return cycle
+
+    t = problem.device_tables()
+    n = problem.jobs
+    m = problem.machines
+    bf16 = bool(getattr(t, "exact_bf16", False))
+    if fam == "lb1":
+        call = _lb1_cycle_call(n, m, M, bf16, interpret)
+
+        def cycle(vals_c, aux_c, valid, best):
+            rows, caux, scal = call(
+                vals_c, aux_c[:, None], valid.astype(jnp.int32)[:, None],
+                jnp.reshape(best, (1,)),
+                t.ptm_t, t.min_heads[None, :], t.min_tails[None, :],
+            )
+            return rows, caux[:, 0], scal[0, 0], scal[0, 1], scal[0, 2]
+
+        return cycle
+
+    # lb2 — Johnson-ordered tables resolved exactly like `pfsp_lb2_bounds`
+    # (device cache when eager, numpy constants under a trace).
+    from . import pfsp_device as PD
+
+    P = t.pairs.shape[0]
+    pg = PD.lb2_kernel_pair_group(P, n)
+    ordered = (t.johnson_ordered_device(pg) if PK._eager_context()
+               else t.johnson_ordered_mp(pg))
+    Pp = ordered.lag_o.shape[0]
+    call = _lb2_cycle_call(n, m, Pp, M, pg, bf16, interpret)
+
+    def cycle(vals_c, aux_c, valid, best):
+        rows, caux, scal = call(
+            vals_c, aux_c[:, None], valid.astype(jnp.int32)[:, None],
+            jnp.reshape(best, (1,)),
+            t.ptm_t, t.min_heads[None, :],
+            ordered.p0_o[:, None, :],
+            ordered.p1_o[:, None, :],
+            ordered.lag_o[:, None, :],
+            ordered.tails0,
+            ordered.tails1,
+            ordered.msel0[:, None, :],
+            ordered.msel1[:, None, :],
+            ordered.jorder,
+        )
+        return rows, caux[:, 0], scal[0, 0], scal[0, 1], scal[0, 2]
+
+    return cycle
+
+
+def megakernel_lb2_bounds(prmu, limit1, tables, interpret: bool | None = None):
+    """The lb2 bound values the megakernel arms with, as a standalone (B, n)
+    call — the bf16 max-plus MXU formulation over the shared
+    `_lb2_tile_lb` body.  The bf16-exactness gate test bit-compares this
+    against the f32 pair-blocked oracle (`pfsp_device._lb2_chunk`) on real
+    Taillard instances; a mismatch means :func:`resolve`'s gate is wrong
+    and the kernel must refuse to arm."""
+    return PK.pfsp_lb2_bounds(prmu, limit1, tables, interpret=interpret,
+                              bf16=True)
+
+
+# ---------------------------------------------------------------------------
+# contracts (tts check)
+# ---------------------------------------------------------------------------
+
+
+@contract(
+    "megakernel-off-identity",
+    claim="TTS_MEGAKERNEL unset (auto, unarmed on the audit's CPU traces) "
+          "and =0 build byte-identical resident step jaxprs — the armed "
+          "body is compiled out when off, never branched",
+    artifact="variants",
+)
+def _contract_megakernel_off_identity(art, cell):
+    if not art.has("off", "mk0"):
+        return []
+    out = []
+    if art.text("off") != art.text("mk0"):
+        out.append("TTS_MEGAKERNEL=0 build differs from the unset build "
+                   "(the armed cycle body leaked into the off path)")
+    if art.outvars("mk0") != art.outvars("off"):
+        out.append("TTS_MEGAKERNEL=0 build changed the carry width")
+    return out
+
+
+@contract(
+    "megakernel-single-call",
+    claim="the armed cycle body is ONE pallas_call — no sort, no "
+          "searchsorted, and no scatter beyond the phase profiler's "
+          "clock-block updates; a build that refused to arm recorded why",
+    artifact="resident-step",
+    applies=lambda cell: cell is not None
+    and getattr(cell, "megakernel", None) == "force",
+)
+def _contract_megakernel_single_call(art, cell):
+    dec = getattr(art.prog, "megakernel", None)
+    if dec is None:
+        return ["resident program carries no megakernel decision"]
+    ncalls = sum(1 for name, _ in art.prims if name == "pallas_call")
+    if not dec.enabled:
+        out = []
+        if not dec.reason:
+            return ["megakernel refused to arm without recording a reason"]
+        if ncalls:
+            out.append(
+                f"refused build ({dec.reason}) still contains "
+                f"{ncalls} pallas_call(s)"
+            )
+        return out
+    out = []
+    if ncalls != 1:
+        out.append(f"armed cycle body contains {ncalls} pallas_call eqns "
+                   "(expected exactly 1)")
+    banned = {"sort", "searchsorted"} & art.prim_names
+    if banned:
+        out.append(f"armed cycle body contains banned primitives: "
+                   f"{sorted(banned)}")
+    # The phase profiler's clock block updates (.at[].add on the
+    # (NSLOTS+1,) uint32 block) lower to tiny scatters — exempt; any
+    # node-data-sized scatter breaks the claim.
+    from ..obs import phases as obs_phases
+
+    for name, eqn in art.prims:
+        if not name.startswith("scatter"):
+            continue
+        if any(v.aval.size > obs_phases.NSLOTS + 1 for v in eqn.outvars):
+            out.append(
+                f"armed cycle body contains a node-sized {name} "
+                f"({[tuple(v.aval.shape) for v in eqn.outvars]})"
+            )
+    return out

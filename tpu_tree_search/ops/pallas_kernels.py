@@ -268,11 +268,9 @@ def lb2_self_kernel_feasible(n: int, m: int, P: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _nqueens_kernel(board_ref, depth_ref, out_ref, *, N: int, g: int):
-    """labels[b, k] = 1 iff board[b, k] placed at column depth_b clashes with
-    no placed queen on either diagonal (`nqueens_gpu_chpl.chpl:99-123`)."""
-    board = board_ref[:].astype(jnp.int32)  # (T, N)
-    depth = depth_ref[:, 0].astype(jnp.int32)  # (T,)
+def _nqueens_tile_labels(board, depth, *, N: int, g: int):
+    """Bool safety labels of one VMEM tile — the body of `_nqueens_kernel`,
+    shared with the one-kernel cycle (`ops/megakernel.py`)."""
     qk = board[:, None, :]  # candidate rows (T, 1, N)
     bi = board[:, :, None]  # placed queens  (T, N, 1)
     i = jax.lax.broadcasted_iota(jnp.int32, (1, N, 1), 1)
@@ -287,7 +285,15 @@ def _nqueens_kernel(board_ref, depth_ref, out_ref, *, N: int, g: int):
     if g > 1:
         safe = jax.lax.fori_loop(0, g - 1, one_round, safe)
     k = jax.lax.broadcasted_iota(jnp.int32, board.shape, 1)
-    out_ref[:] = (safe & (k >= depth[:, None])).astype(jnp.uint8)
+    return safe & (k >= depth[:, None])
+
+
+def _nqueens_kernel(board_ref, depth_ref, out_ref, *, N: int, g: int):
+    """labels[b, k] = 1 iff board[b, k] placed at column depth_b clashes with
+    no placed queen on either diagonal (`nqueens_gpu_chpl.chpl:99-123`)."""
+    board = board_ref[:].astype(jnp.int32)  # (T, N)
+    depth = depth_ref[:, 0].astype(jnp.int32)  # (T,)
+    out_ref[:] = _nqueens_tile_labels(board, depth, N=N, g=g).astype(jnp.uint8)
 
 
 @lru_cache(maxsize=None)
@@ -409,6 +415,27 @@ def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int,
     return onehot, ptg, front, remain, child_front
 
 
+def _lb1_tile_lb(prmu, limit1, ptm, heads, tails, scan_ref,
+                 *, n: int, m: int, bf16: bool = False):
+    """(T, n) int32 lb1 bound of every child in the tile — the body of
+    `_lb1_kernel`, shared with the one-kernel cycle (`ops/megakernel.py`)."""
+    _, ptg, _, remain, child_front = _tile_parent_state(
+        prmu, limit1, ptm, heads, scan_ref, n, m, bf16
+    )
+
+    # Child k: machine bound chain, unrolled over m. Per-machine remain as a
+    # 2-D slice (see the relayout note in _tile_parent_state).
+    tmp0 = child_front[0] + (remain[:, 0:1] - ptg[..., 0])
+    lb = tmp0 + tails[0, 0]
+    for i in range(1, m):
+        tmp1 = jnp.maximum(
+            tmp0, child_front[i] + (remain[:, i:i + 1] - ptg[..., i])
+        )
+        lb = jnp.maximum(lb, tmp1 + tails[0, i])
+        tmp0 = tmp1
+    return lb
+
+
 def _lb1_kernel(
     prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref, out_ref, scan_ref,
     *, n: int, m: int, bf16: bool = False
@@ -422,22 +449,10 @@ def _lb1_kernel(
     prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
     limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
     ptm = ptm_ref[:].astype(jnp.float32)  # (n, m) job-major
-    _, ptg, _, remain, child_front = _tile_parent_state(
-        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m, bf16
+    out_ref[:] = _lb1_tile_lb(
+        prmu, limit1, ptm, heads_ref[:], tails_ref[:], scan_ref,
+        n=n, m=m, bf16=bf16,
     )
-
-    # Child k: machine bound chain, unrolled over m. Per-machine remain as a
-    # 2-D slice (see the relayout note in _tile_parent_state).
-    tails = tails_ref[:]  # (1, m)
-    tmp0 = child_front[0] + (remain[:, 0:1] - ptg[..., 0])
-    lb = tmp0 + tails[0, 0]
-    for i in range(1, m):
-        tmp1 = jnp.maximum(
-            tmp0, child_front[i] + (remain[:, i:i + 1] - ptg[..., i])
-        )
-        lb = jnp.maximum(lb, tmp1 + tails[0, i])
-        tmp0 = tmp1
-    out_ref[:] = lb
 
 
 @lru_cache(maxsize=None)
@@ -530,35 +545,19 @@ def pfsp_lb1_d_bounds(
     )
 
 
-def _lb2_kernel(
-    prmu_ref, limit1_ref, ptm_ref, heads_ref,
+def _lb2_tile_lb(
+    prmu, limit1, ptm, heads,
     p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
-    out_ref, scan_ref, *, n: int, m: int, P: int, pg: int = 1,
-    bf16: bool = False,
+    scan_ref, *, n: int, m: int, P: int, pg: int = 1, bf16: bool = False,
 ):
-    """Full lb2 (two-machine Johnson) bound of every child in the tile.
-
-    Math identical to `ops/pfsp_device._lb2_chunk` (the closed-form max-plus
-    scan of `c_bound_johnson.c:190-234`, early exit dropped — see that
-    module's docstring). The decisive difference from the jnp path: the
-    whole pair loop runs against VMEM-resident tile state (child fronts,
-    free-job flags, the Johnson-ordered tables), so the ~P x (B, n, n)
-    intermediates never touch HBM.
-
-    ``pg``: pair-group unroll — the fori_loop runs over P/pg pair GROUPS
-    (caller pads P to a multiple) with pg statically-unrolled pair bodies
-    per iteration, giving the VPU/MXU pg independent chains to overlap
-    instead of one serialized pair per loop step (the pair-axis batching
-    of the blocked jnp path, expressed as unrolling here — the VMEM model
-    charges the per-pair live values once per group member).
-    """
-    prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
-    limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
-    ptm = ptm_ref[:].astype(jnp.float32)  # (n, m)
+    """(T, n) f32 lb2 bound of every child in the tile — the body of
+    `_lb2_kernel`, shared with the one-kernel cycle (`ops/megakernel.py`).
+    Mixed value/Ref signature: the per-pair tables stay Refs because the
+    pair loop indexes them dynamically on a non-tiled leading axis."""
     T = prmu.shape[0]
     hp = _hp_dot
     onehot, _, _, _, cf = _tile_parent_state(
-        prmu, limit1, ptm, heads_ref[:], scan_ref, n, m, bf16
+        prmu, limit1, ptm, heads, scan_ref, n, m, bf16
     )
     child_front = jnp.stack(cf, axis=-1).astype(jnp.float32)  # (T, n, m)
 
@@ -619,6 +618,39 @@ def _lb2_kernel(
         lb = jax.lax.fori_loop(0, P // pg, group_body, lb0)
     else:
         lb = jax.lax.fori_loop(0, P, pair_body, lb0)
+    return lb
+
+
+def _lb2_kernel(
+    prmu_ref, limit1_ref, ptm_ref, heads_ref,
+    p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
+    out_ref, scan_ref, *, n: int, m: int, P: int, pg: int = 1,
+    bf16: bool = False,
+):
+    """Full lb2 (two-machine Johnson) bound of every child in the tile.
+
+    Math identical to `ops/pfsp_device._lb2_chunk` (the closed-form max-plus
+    scan of `c_bound_johnson.c:190-234`, early exit dropped — see that
+    module's docstring). The decisive difference from the jnp path: the
+    whole pair loop runs against VMEM-resident tile state (child fronts,
+    free-job flags, the Johnson-ordered tables), so the ~P x (B, n, n)
+    intermediates never touch HBM.
+
+    ``pg``: pair-group unroll — the fori_loop runs over P/pg pair GROUPS
+    (caller pads P to a multiple) with pg statically-unrolled pair bodies
+    per iteration, giving the VPU/MXU pg independent chains to overlap
+    instead of one serialized pair per loop step (the pair-axis batching
+    of the blocked jnp path, expressed as unrolling here — the VMEM model
+    charges the per-pair live values once per group member).
+    """
+    prmu = prmu_ref[:].astype(jnp.int32)  # (T, n)
+    limit1 = limit1_ref[:, 0].astype(jnp.int32)  # (T,)
+    ptm = ptm_ref[:].astype(jnp.float32)  # (n, m)
+    lb = _lb2_tile_lb(
+        prmu, limit1, ptm, heads_ref[:],
+        p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref,
+        jorder_ref, scan_ref, n=n, m=m, P=P, pg=pg, bf16=bf16,
+    )
     out_ref[:] = lb.astype(jnp.int32)
 
 

@@ -771,12 +771,17 @@ def routing_cache_token(problem, device=None) -> tuple:
     silently reusing a stale program. One definition — used by both the
     resident and mesh-resident cache keys."""
     from . import pallas_kernels as PK
+    from .megakernel import megakernel_mode
 
     tok: tuple = (PK.use_pallas(device), PK.pallas_interpret(),
                   # lb1-family demotion override (TTS_PALLAS=force) is a
                   # trace-time routing decision like the rest.
                   PK.pallas_forced(),
-                  compact_mode())
+                  compact_mode(),
+                  # One-kernel cycle knob (ops/megakernel.py): the raw mode
+                  # — the rest of the decision (M, device, family, mp) is
+                  # already in every program cache key carrying this token.
+                  megakernel_mode())
     if getattr(problem, "name", None) == "pfsp" and problem.lb == "lb2":
         tok += (
             _lb2_pallas_enabled(),

@@ -754,6 +754,9 @@ def mesh_resident_search(
                 steps=controller.steps,
                 compact=program.inner.compact,
                 compact_auto=program.inner.compact_auto,
+                megakernel=program.inner.megakernel.state,
+                megakernel_auto=program.inner.megakernel.auto,
+                megakernel_reason=program.inner.megakernel.reason,
                 pipeline_depth=depth,
                 k_resolved=program.K,
                 k_auto=k_auto,
@@ -846,6 +849,9 @@ def mesh_resident_search(
         steps=controller.steps,
         compact=program.inner.compact,
         compact_auto=program.inner.compact_auto,
+        megakernel=program.inner.megakernel.state,
+        megakernel_auto=program.inner.megakernel.auto,
+        megakernel_reason=program.inner.megakernel.reason,
         pipeline_depth=depth,
         k_resolved=program.K,
         k_auto=k_auto,
