@@ -1166,6 +1166,111 @@ def _batch_ab_rows(extras: list) -> None:
         })
 
 
+def steal_ab(problem=None, m: int = 5, M: int = 64, D: int = 1,
+             hosts: int = 6, pods: int = 2,
+             ici_lat_s: float = 0.002, dcn_lat_s: float = 0.25,
+             interval_s: float = 0.005) -> dict:
+    """Hierarchical-stealing A/B on the CPU-sim virtual-host harness
+    (ISSUE 14 acceptance row): the same dist-tier search, flat vs hier
+    (``TTS_STEAL``), over 6 virtual hosts in 2 pods (``TTS_PODS``) with
+    injected asymmetric link latencies (cheap ICI, expensive DCN) and
+    adversarial initial imbalance — one rich host per pod (hosts 0 and
+    ``hosts//2``), every other host starts empty. Flat's matching is
+    topology-blind: its size-ordered donor->needy zip systematically
+    pairs rich hosts with needy hosts ACROSS pods, paying the injected
+    DCN latency while a same-pod donor sits unused, and its tail ships
+    end-of-run scraps over the same expensive link. Hier feeds every
+    starved host from its own pod over ICI and takes the far link only
+    for bulk quanta that amortize the latency (parallel/topology.py).
+    Reported per mode: wall time, mean worker idle fraction (from the
+    drained host trace, obs/report.summarize), donation totals, and the
+    resolved policy — parity-gated on bit-identical node counts vs
+    sequential (N-Queens never prunes, so ANY steal schedule must
+    reproduce them)."""
+    from tpu_tree_search.engine import sequential_search
+    from tpu_tree_search.obs import events as obs_events
+    from tpu_tree_search.obs import report as obs_report
+    from tpu_tree_search.parallel.dist import dist_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    if problem is None:
+        problem = NQueensProblem(N=10)
+    seq = sequential_search(problem)
+    golden = (seq.explored_tree, seq.explored_sol)
+    rich = (0, hosts // 2)  # one donor per pod
+
+    def skew(warm, host_id, num_hosts):
+        n = len(next(iter(warm.values())))
+        if host_id == rich[0]:
+            return {k: v[: n // 2] for k, v in warm.items()}
+        if host_id == rich[1]:
+            return {k: v[n // 2:] for k, v in warm.items()}
+        return {k: v[:0] for k, v in warm.items()}
+
+    # Warm the compile cache outside the measured pair: the first dist run
+    # traces the chunk program, and that cost must not land in one arm's
+    # busy spans (no latency injection, default balanced partition).
+    dist_search(problem, m=m, M=M, D=D, num_hosts=hosts,
+                steal_interval_s=interval_s)
+
+    out: dict = {
+        "metric": "steal_ab_sim",
+        "hosts": hosts,
+        "pods": pods,
+        "workers_per_host": D,
+        "ici_lat_ms": round(1e3 * ici_lat_s, 1),
+        "dcn_lat_ms": round(1e3 * dcn_lat_s, 1),
+        "golden_tree": golden[0],
+    }
+    for mode in ("flat", "hier"):
+        with _env_override("TTS_STEAL", mode), \
+                _env_override("TTS_PODS", str(pods)), \
+                _env_override("TTS_SIM_LAT_ICI", str(ici_lat_s)), \
+                _env_override("TTS_SIM_LAT_DCN", str(dcn_lat_s)), \
+                _env_override("TTS_OBS", "host"):
+            obs_events.reset()
+            t0 = time.perf_counter()
+            res = dist_search(problem, m=m, M=M, D=D, num_hosts=hosts,
+                              steal_interval_s=interval_s,
+                              partition_fn=skew)
+            wall = time.perf_counter() - t0
+            summ = obs_report.summarize(obs_events.drain())
+        idle = [w["idle_fraction"] for w in summ["idle"].values()]
+        links = {
+            k: {"attempts": v["attempts"], "hits": v["hits"]}
+            for k, v in summ["steal_links"].items()
+        }
+        out[f"{mode}_s"] = round(wall, 3)
+        out[f"{mode}_idle_frac"] = round(
+            sum(idle) / len(idle), 4) if idle else None
+        out[f"{mode}_blocks"] = (res.comm or {}).get("blocks_received")
+        out[f"{mode}_nodes"] = (res.comm or {}).get("nodes_received")
+        out[f"{mode}_links"] = links
+        out[f"{mode}_parity"] = (
+            (res.explored_tree, res.explored_sol) == golden
+        )
+        if mode == "hier":
+            out["policy"] = res.steal_policy
+    out["parity"] = out["flat_parity"] and out["hier_parity"]
+    out["speedup"] = round(out["flat_s"] / max(out["hier_s"], 1e-9), 3)
+    if (out["flat_idle_frac"] is not None
+            and out["hier_idle_frac"] is not None):
+        out["idle_drop"] = round(
+            out["flat_idle_frac"] - out["hier_idle_frac"], 4)
+    return out
+
+
+def _steal_ab_rows(extras: list) -> None:
+    """Hierarchical-stealing A/B row (never fails the bench)."""
+    try:
+        extras.append(steal_ab())
+    except Exception as e:  # noqa: BLE001 — A/B rows never fail a bench
+        extras.append({
+            "metric": "steal_ab_sim",
+            "error": f"{type(e).__name__}: {e}",
+        })
+
+
 def _megakernel_ab_rows(extras: list, on_tpu: bool) -> None:
     """One-kernel-cycle A/B (ops/megakernel.py — the keep/retire evidence
     row, docs/HW_VALIDATION.md). Off-chip the row is a PARITY GATE only:
@@ -1560,6 +1665,10 @@ def _main(partial: BenchPartial) -> int:
         # timed off-vs-force ta014 lb1 rows on TPU (the keep/retire
         # evidence, docs/HW_VALIDATION.md).
         _megakernel_ab_rows(extras, on_tpu)
+        # Hierarchical-stealing A/B: flat vs hier on the virtual-host
+        # simulated-latency harness, parity-gated on node counts
+        # (CPU-sim, every backend — the TTS_STEAL evidence row).
+        _steal_ab_rows(extras)
     # Published-config rate rows run in BOTH modes (bounded — a few
     # dispatches each), so any green window banks a first ta021/N16/N17
     # number automatically.

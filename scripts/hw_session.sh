@@ -101,6 +101,25 @@ for B in (1, 4, 8):
 print(json.dumps(row))
 EOF
 
+echo "== 6c/9 hierarchical-stealing A/B (flat vs hier, banked row) =="
+# The TTS_STEAL evidence row (docs/PARALLELISM.md): flat vs hier on the
+# virtual-host simulated-latency harness — 6 hosts in 2 pods, injected
+# asymmetric ICI/DCN latencies, adversarial initial imbalance, parity
+# gated on bit-identical N-Queens counts. Runs on the CPU backend BY
+# DESIGN (the latencies are injected, not measured; a TPU run would
+# measure nothing extra) — banked from the session so the row rides the
+# same provenance as the hardware artifacts.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  timeout 900 python - <<'EOF' | tee STEAL_AB.json \
+  || echo "STEAL AB FAILED"
+import json
+from bench import steal_ab
+
+row = steal_ab()
+assert row["parity"], "steal A/B parity broke: counts depend on schedule"
+print(json.dumps(row))
+EOF
+
 echo "== 7/9 post-mortem + cost-model banking =="
 # Bank whatever the flight recorder dumped (a stage above that died on a
 # dead tunnel or hung dispatch left a post-mortem naming its last

@@ -1,0 +1,352 @@
+"""Topology-aware hierarchical work stealing (parallel/topology.py,
+TTS_STEAL=hier): link classification, cost-model-resolved per-level
+periods/quanta, near-first/escalate-far matching with the far
+amortization floor — and the cross-communicator guarantees: node counts
+stay bit-identical to the flat default, and under injected asymmetric
+link latency the hierarchy strictly reduces idle time (docs/PARALLELISM.md)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_tree_search.engine import sequential_search
+from tpu_tree_search.obs import costmodel as cm
+from tpu_tree_search.parallel.topology import (
+    FAR_EVERY_DEFAULT,
+    FAR_QUANTUM_MULT,
+    LINK_DCN,
+    LINK_ICI,
+    LINK_LOCAL,
+    SimLinks,
+    Topology,
+    _parse_pods,
+    resolve_policy,
+    steal_mode,
+)
+from tpu_tree_search.problems import NQueensProblem
+
+
+@pytest.fixture(autouse=True)
+def _clean_steal_env(monkeypatch):
+    for k in ("TTS_STEAL", "TTS_PODS", "TTS_SIM_LAT_ICI", "TTS_SIM_LAT_DCN",
+              "TTS_COSTMODEL", "TTS_OBS"):
+        monkeypatch.delenv(k, raising=False)
+
+
+# -- knob + pod-map parsing ---------------------------------------------------
+
+
+def test_steal_mode_default_flat_and_typo_safe(monkeypatch):
+    assert steal_mode() == "flat"
+    monkeypatch.setenv("TTS_STEAL", "hier")
+    assert steal_mode() == "hier"
+    monkeypatch.setenv("TTS_STEAL", "HIER ")
+    assert steal_mode() == "hier"
+    # an unrecognized value must never change semantics
+    monkeypatch.setenv("TTS_STEAL", "hierarchical")
+    assert steal_mode() == "flat"
+
+
+def test_parse_pods_grammar():
+    assert _parse_pods("2", 6) == [0, 0, 0, 1, 1, 1]
+    assert _parse_pods("2", 4) == [0, 0, 1, 1]
+    assert _parse_pods("3", 3) == [0, 1, 2]
+    assert _parse_pods("0,0,1,1", 4) == [0, 0, 1, 1]
+    # mismatched list length, non-positive K, garbage, empty -> None
+    assert _parse_pods("0,1", 4) is None
+    assert _parse_pods("0", 4) is None
+    assert _parse_pods("two", 4) is None
+    assert _parse_pods("", 4) is None
+
+
+def test_topology_link_classes(monkeypatch):
+    topo = Topology(4, [0, 0, 1, 1])
+    assert topo.link_class(0, 0) == LINK_LOCAL
+    assert topo.link_class(0, 1) == LINK_ICI
+    assert topo.link_class(1, 2) == LINK_DCN
+    assert topo.num_pods == 2
+    # detect: TTS_PODS wins
+    monkeypatch.setenv("TTS_PODS", "2")
+    assert Topology.detect(4).pod_of == [0, 0, 1, 1]
+    monkeypatch.delenv("TTS_PODS")
+    # detect: slice indices assembled over the allgather
+    det = Topology.detect(3, slice_index=1, allgather=lambda v: [0, 1, 1])
+    assert det.pod_of == [0, 1, 1]
+    # default: one pod, every inter-host link is ici
+    one = Topology.detect(3)
+    assert one.link_class(0, 2) == LINK_ICI
+
+
+def test_sim_links_env_armed(monkeypatch):
+    assert not SimLinks().armed
+    monkeypatch.setenv("TTS_SIM_LAT_ICI", "0.001")
+    sim = SimLinks()
+    assert sim.armed
+    assert sim.lat_s == {LINK_ICI: 0.001}
+    sim.sleep(LINK_DCN)  # unarmed class: no-op, returns immediately
+    monkeypatch.setenv("TTS_SIM_LAT_DCN", "not-a-float")
+    assert SimLinks().lat_s == {LINK_ICI: 0.001}
+
+
+# -- cost-model quantum / period resolution -----------------------------------
+
+
+def _entry(ici_lat=None, dcn_lat=None, per_byte=0.0, eval_us=10.0):
+    links = {"offload": {"per_unit_us": eval_us}}
+    if ici_lat is not None:
+        links["donate:ici"] = {"latency_us": ici_lat, "per_unit_us": per_byte}
+    if dcn_lat is not None:
+        links["donate:dcn"] = {"latency_us": dcn_lat, "per_unit_us": per_byte}
+    return {"links": links}
+
+
+def test_steal_quantum_amortization_formula():
+    # Q >= lat / (frac*eval - bpn*per_byte): 100us latency, 10us/node
+    # eval, frac 0.10 -> denom 1.0 -> Q = 100 nodes.
+    e = _entry(ici_lat=100.0)
+    assert cm.steal_quantum(e, "ici", m=5, bytes_per_node=0, cap=1000) == 100
+    # clamped below by 2m (pop_front_bulk_half's donor threshold)...
+    e = _entry(ici_lat=1.0)
+    assert cm.steal_quantum(e, "ici", m=50, bytes_per_node=0, cap=1000) == 100
+    # ...and above by cap
+    e = _entry(ici_lat=1e6)
+    assert cm.steal_quantum(e, "ici", m=5, bytes_per_node=0, cap=512) == 512
+    # per-byte cost alone over budget -> maximally bulk (cap)
+    e = _entry(ici_lat=100.0, per_byte=1.0, eval_us=5.0)
+    assert cm.steal_quantum(e, "ici", m=5, bytes_per_node=64, cap=777) == 777
+    # no fit for the link -> None (caller keeps the fixed fallback)
+    assert cm.steal_quantum(_entry(), "dcn", m=5, bytes_per_node=0,
+                            cap=100) is None
+
+
+def test_steal_every_period_formula():
+    # 2ms dcn latency over a 5ms round, frac 0.10 -> every 4th round
+    e = _entry(dcn_lat=2000.0)
+    assert cm.steal_every(e, 0.005) == 4
+    # huge latency clamps at the cap
+    e = _entry(dcn_lat=50000.0)
+    assert cm.steal_every(e, 0.005, cap=32) == 32
+    # floor of 2: a far round can never fire EVERY round
+    e = _entry(dcn_lat=1.0)
+    assert cm.steal_every(e, 0.005) == 2
+    assert cm.steal_every(_entry(), 0.005) is None
+
+
+def test_resolve_policy_flat_is_legacy(monkeypatch):
+    topo = Topology(4, [0, 0, 1, 1])
+    pol = resolve_policy(NQueensProblem(N=6), topo, m=5, cap=64,
+                         interval_s=0.01)
+    assert not pol.hier
+    # flat: one cap on every link, describe() says so
+    for link in (LINK_LOCAL, LINK_ICI, LINK_DCN):
+        assert pol.cap_for(link) == 64
+    d = pol.describe()
+    assert d["mode"] == "flat"
+    assert d["levels"]["any"]["quantum"] == 64
+
+
+def test_resolve_policy_hier_fixed_fallbacks(monkeypatch):
+    monkeypatch.setenv("TTS_STEAL", "hier")
+    topo = Topology(4, [0, 0, 1, 1])
+    pol = resolve_policy(NQueensProblem(N=6), topo, m=5, cap=64,
+                         interval_s=0.01)
+    assert pol.hier
+    near, far = pol.levels[LINK_ICI], pol.levels[LINK_DCN]
+    assert (near.level, near.every, near.quantum) == (1, 1, 64)
+    assert (far.level, far.every) == (2, FAR_EVERY_DEFAULT)
+    assert far.quantum == 64 * FAR_QUANTUM_MULT
+    assert near.source == far.source == "fixed"
+    assert far.period_s == pytest.approx(0.01 * FAR_EVERY_DEFAULT)
+    d = pol.describe()
+    assert set(d["levels"]) == {LINK_ICI, LINK_DCN}
+    assert d["levels"][LINK_DCN]["quantum"] == far.quantum
+
+
+def test_resolve_policy_reads_costmodel_profile(tmp_path, monkeypatch):
+    # A synthetic measured profile: the resolved quanta/periods must come
+    # from the fits (source = the profile key), not the fixed fallbacks.
+    import json
+
+    problem = NQueensProblem(N=6)
+    key = cm.profile_key("cpu", "topo-x", cm.shape_class(problem))
+    prof = {key: _entry(ici_lat=100.0, dcn_lat=2000.0)}
+    path = tmp_path / "COSTMODEL.json"
+    path.write_text(json.dumps(prof))
+    monkeypatch.setenv("TTS_COSTMODEL", str(path))
+    monkeypatch.setenv("TTS_STEAL", "hier")
+    pol = resolve_policy(problem, Topology(4, [0, 0, 1, 1]), m=5, cap=64,
+                         interval_s=0.005, backend="cpu", topo_str="topo-x")
+    near, far = pol.levels[LINK_ICI], pol.levels[LINK_DCN]
+    assert near.source == key and far.source == key
+    assert near.quantum == 100            # amortization formula above
+    assert far.every == 4                 # 2ms latency / (0.1 * 5ms)
+    assert far.quantum >= near.quantum    # far is never smaller than near
+
+
+# -- the two-level matching ---------------------------------------------------
+
+
+def _hier_policy(pods, m=5, cap=64, monkeypatch=None):
+    pol = resolve_policy(NQueensProblem(N=6), Topology(len(pods), pods),
+                         m=m, cap=cap, interval_s=0.01, mode="hier")
+    return pol
+
+
+def test_match_prefers_near_link():
+    pol = _hier_policy([0, 0, 1, 1])
+    # host 1 is needy; donors 0 (same pod, ici) and 2 (cross-pod, dcn)
+    # exist. The near donor must win even on a far round.
+    assert pol.match([2, 0], [1], round_no=0) == [(0, 1)]
+
+
+def test_match_far_only_on_far_rounds():
+    pol = _hier_policy([0, 0, 1, 1])
+    every = pol.levels[LINK_DCN].every
+    # only a cross-pod donor exists for host 3's pod-mate-less need
+    assert pol.match([0], [3], round_no=0) == [(0, 3)]
+    for r in range(1, every):
+        assert pol.match([0], [3], round_no=r) == []
+    assert pol.match([0], [3], round_no=every) == [(0, 3)]
+
+
+def test_match_far_amortization_floor():
+    pol = _hier_policy([0, 0, 1, 1], m=5, cap=64)
+    floor = max(4 * 5, pol.levels[LINK_DCN].quantum // 2)
+    sizes = [0] * 4
+    # a far donor below the floor must NOT ship scraps across the link
+    sizes[0] = floor - 1
+    assert pol.match([0], [3], round_no=0, sizes=sizes) == []
+    sizes[0] = floor
+    assert pol.match([0], [3], round_no=0, sizes=sizes) == [(0, 3)]
+    # the floor never applies to near pairs
+    assert pol.match([0], [1], round_no=0, sizes=[1, 0, 0, 0]) == [(0, 1)]
+
+
+def test_match_is_deterministic_and_one_to_one():
+    pol = _hier_policy([0, 0, 0, 1, 1, 1])
+    donors, needy = [0, 3], [1, 2, 4]
+    a = pol.match(donors, needy, round_no=0)
+    b = pol.match(list(donors), list(needy), round_no=0)
+    assert a == b  # same inputs on every host -> same pairs, no handshake
+    assert len({d for d, _ in a}) == len(a)  # each donor used at most once
+    assert {(0, 1), (3, 4)} == set(a)        # in-pod feeds, no crossing
+
+
+# -- cross-communicator parity (the N-Queens invariance gate) -----------------
+
+
+def test_dist_hier_counts_bit_identical(monkeypatch):
+    from tpu_tree_search.parallel.dist import dist_search
+
+    seq = sequential_search(NQueensProblem(N=9))
+    monkeypatch.setenv("TTS_STEAL", "hier")
+    monkeypatch.setenv("TTS_PODS", "2")
+    res = dist_search(NQueensProblem(N=9), m=5, M=128, D=1, num_hosts=4)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    # the resolved policy is surfaced on the result
+    assert res.steal_policy["mode"] == "hier"
+    assert res.steal_policy["pods"] == [0, 0, 1, 1]
+    levels = res.steal_policy["levels"]
+    assert {"every", "quantum", "period_s", "source"} <= set(levels[LINK_ICI])
+
+
+def test_dist_mesh_hier_counts_bit_identical(monkeypatch):
+    from tpu_tree_search.parallel.dist_mesh import dist_mesh_search
+
+    seq = sequential_search(NQueensProblem(N=10))
+    monkeypatch.setenv("TTS_STEAL", "hier")
+    monkeypatch.setenv("TTS_PODS", "2")
+    res = dist_mesh_search(NQueensProblem(N=10), m=5, M=128, K=4, D=2,
+                           num_hosts=2)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    assert res.steal_policy and res.steal_policy["mode"] == "hier"
+
+
+# -- the flat-vs-hier A/B under injected asymmetric latency -------------------
+
+
+def test_hier_beats_flat_under_injected_latency():
+    """The bench harness' adversarial case (one rich host per pod, DCN two
+    orders of magnitude slower than ICI): flat's topology-blind zip pairs
+    across pods while same-pod donors sit unused; hier must land identical
+    node counts with strictly less idle time. Wall time is asserted with a
+    generous margin (the strict gate is bench.py steal_ab / hw_session
+    stage 6c, which also banks STEAL_AB.json)."""
+    from bench import steal_ab
+
+    row = steal_ab()
+    assert row["parity"], row
+    assert row["hier_idle_frac"] < row["flat_idle_frac"], row
+    assert row["hier_s"] < row["flat_s"] * 1.10, row
+
+
+# -- observability: report table, flight recorder, live view ------------------
+
+
+def test_report_per_link_steal_table():
+    from tpu_tree_search.obs import report
+
+    evts = [
+        {"name": "steal", "ts": 0.0, "dur": 50.0, "pid": 0, "tid": 1,
+         "args": {"link": "local", "nodes": 10, "bytes": 80}},
+        {"name": "steal_miss", "ts": 10.0, "pid": 0, "tid": 1,
+         "args": {"link": "local"}},
+        {"name": "donate_send", "ts": 20.0, "dur": 200.0, "pid": 0, "tid": 9,
+         "args": {"link": "ici", "nodes": 8, "bytes": 64}},
+        {"name": "donate_recv", "ts": 30.0, "dur": 300.0, "pid": 1, "tid": 9,
+         "args": {"link": "ici", "nodes": 8, "bytes": 64}},
+        {"name": "donate_recv", "ts": 40.0, "dur": 900.0, "pid": 1, "tid": 9,
+         "args": {"link": "dcn", "nodes": 64, "bytes": 512}},
+        # a pre-hierarchy event without a link stamp: ignored, not crashed
+        {"name": "steal", "ts": 50.0, "dur": 5.0, "pid": 0, "tid": 2,
+         "args": {"nodes": 3}},
+    ]
+    links = report.summarize(evts)["steal_links"]
+    assert links["local"] == {"attempts": 2, "hits": 1, "misses": 1,
+                              "nodes": 10, "bytes": 80, "mean_cost_us": 50.0}
+    assert links["ici"]["attempts"] == 1 and links["ici"]["hits"] == 1
+    assert links["ici"]["mean_cost_us"] == 300.0
+    assert links["dcn"]["nodes"] == 64
+    text = report.render(report.summarize(evts))
+    assert "ici" in text and "dcn" in text and "mean_cost" in text
+
+
+def test_flightrec_steal_link_in_snapshot():
+    from tpu_tree_search.obs.flightrec import FlightRecorder
+
+    rec = FlightRecorder(always_on=True, snapshot_period_us=0.0)
+    rec.heartbeat("dist", host=0, wid=0, seq=1, cycles=10)
+    rec.note_steal(0, "dcn", 2)
+    rec.heartbeat("dist", host=0, wid=0, seq=2, cycles=10)
+    snap = rec.latest()
+    assert snap["steal_link"] == "dcn"
+    assert snap["steal_level"] == 2
+
+
+def test_live_snapshot_prints_steal_level():
+    from tpu_tree_search.obs.live import format_snapshot
+
+    text = format_snapshot({"tier": "dist", "steal_link": "ici",
+                            "steal_level": 1})
+    assert "steal=ici" in text
+
+
+def test_cli_json_and_banner_surface_policy(capsys, monkeypatch):
+    from tpu_tree_search import cli
+
+    monkeypatch.setenv("TTS_STEAL", "hier")
+    monkeypatch.setenv("TTS_PODS", "2")
+    assert cli.main(["nqueens", "--N", "8", "--tier", "dist", "--m", "5",
+                     "--M", "64", "--hosts", "2", "--json"]) == 0
+    import json
+
+    out = capsys.readouterr().out
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["steal_policy"]["mode"] == "hier"
+    assert rec["steal_policy"]["levels"][LINK_DCN]["every"] >= 2
+    # the settings banner names the knob
+    assert "TTS_STEAL" in out

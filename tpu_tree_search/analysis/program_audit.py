@@ -54,7 +54,8 @@ KNOBS = (
     "TTS_COMPACT", "TTS_OBS", "TTS_PHASEPROF", "TTS_LB2_PAIRBLOCK",
     "TTS_PIPELINE", "TTS_K", "TTS_GUARD", "TTS_PALLAS", "TTS_PALLAS_LB2",
     "TTS_LB2_STAGED", "TTS_XLA_TRACE", "TTS_FLIGHTREC", "TTS_COSTMODEL",
-    "TTS_QUALITY", "TTS_MEGAKERNEL",
+    "TTS_QUALITY", "TTS_MEGAKERNEL", "TTS_STEAL", "TTS_PODS",
+    "TTS_SIM_LAT_ICI", "TTS_SIM_LAT_DCN",
 )
 
 #: Matrix axes (the lb2 families add the pair-block axis).
@@ -72,6 +73,7 @@ def load_contracts() -> dict:
     from ..engine import batched, pipeline, resident  # noqa: F401
     from ..obs import counters, phases, quality  # noqa: F401
     from ..ops import compaction, megakernel, pfsp_device  # noqa: F401
+    from ..parallel import topology  # noqa: F401
     from . import guard, lockorder  # noqa: F401
 
     return CONTRACTS
@@ -389,6 +391,8 @@ VARIANT_ENVS = {
     "guard1": {"TTS_GUARD": "1"},
     "quality1": {"TTS_QUALITY": "1"},
     "mk0": {"TTS_MEGAKERNEL": "0"},
+    "steal-flat": {"TTS_STEAL": "flat"},
+    "steal-hier": {"TTS_STEAL": "hier", "TTS_PODS": "2"},
 }
 
 
@@ -481,6 +485,7 @@ def cache_key_artifact(family: str) -> CacheKeyArtifact:
     shared = {
         "TTS_PIPELINE": (p0, build({**base, "TTS_PIPELINE": "2"})),
         "TTS_GUARD": (p0, build({**base, "TTS_GUARD": "1"})),
+        "TTS_STEAL": (p0, build({**base, "TTS_STEAL": "hier"})),
         "rebuild": (p0, build(base)),
     }
     return CacheKeyArtifact(distinct=distinct, shared=shared)

@@ -709,6 +709,17 @@ def print_settings(args) -> None:
         if args.xla_trace is not None:
             print(f"XLA trace capture (TTS_XLA_TRACE): {args.xla_trace} "
                   "(steady-state dispatch window)")
+    if args.tier in ("dist", "dist_mesh"):
+        # Raw steal-hierarchy knob; the RESOLVED per-link-class periods
+        # and quanta are printed with the results and recorded in the
+        # stats line (parallel/topology.py).
+        import os
+
+        from .parallel.topology import steal_mode
+
+        pods = os.environ.get("TTS_PODS")
+        print(f"Inter-host stealing (TTS_STEAL): {steal_mode()}"
+              + (f"; pod map (TTS_PODS): {pods}" if pods else ""))
     print("=================================================")
 
 
@@ -798,6 +809,16 @@ def print_results(args, problem, res) -> None:
             f"stolen_blocks={c['blocks_received']} "
             f"stolen_nodes={c['nodes_received']}"
         )
+    if res.steal_policy:
+        # The RESOLVED steal hierarchy (parallel/topology.py): one line
+        # per link class — level, match period, and donation quantum,
+        # with the COSTMODEL.json key each resolved from (or "fixed").
+        sp = res.steal_policy
+        print(f"Steal policy: {sp['mode']} pods={sp['pods']}")
+        for link, s in sp.get("levels", {}).items():
+            print(f"  {link}: level={s['level']} every={s['every']} "
+                  f"period={s['period_s']}s quantum={s['quantum']} "
+                  f"({s['source']})")
     print("=================================================\n")
 
 
@@ -815,6 +836,10 @@ def result_record(args, res) -> dict:
         rec["steals"] = res.steals
     if res.comm:
         rec["comm"] = res.comm
+    if res.steal_policy:
+        # The resolved steal hierarchy (TTS_STEAL, parallel/topology.py):
+        # per-link-class periods/quanta + the profile key each came from.
+        rec["steal_policy"] = res.steal_policy
     if res.obs:
         # On-device counter totals (TTS_OBS=1): the stats line carries the
         # run's telemetry snapshot like the reference's diagnostics counters

@@ -60,6 +60,13 @@ class SearchResult:
     # dist tier: inter-host communicator totals (exchange rounds, stolen
     # blocks/nodes), summed across hosts.
     comm: dict | None = None
+    # dist/dist_mesh tiers: the resolved steal policy (TTS_STEAL,
+    # parallel/topology.py) — {"mode", "pods", "levels": {link: {level,
+    # every, period_s, quantum, source}}} where source names the
+    # COSTMODEL.json profile key the quantum/period resolved from (or
+    # "fixed"). Identical on every host; None for tiers without an
+    # inter-host communicator.
+    steal_policy: dict | None = None
     # Resident tiers: the survivor-path compaction mode the compiled step
     # baked in (ops/compaction.py — "dense"/"scatter"/"sort"/"search"),
     # with compact_auto True when the TTS_COMPACT=auto policy chose it.

@@ -22,7 +22,13 @@ device):
   * ``exchange`` — dist/dist_mesh communicator ``exchange`` spans:
     the inter-host control-round (allgather over DCN/KV) latency.
   * ``donate``   — ``donate_send``/``donate_recv`` spans: duration vs
-    payload bytes — the DCN/KV work-migration bandwidth.
+    payload bytes — the DCN/KV work-migration bandwidth. Spans stamped
+    with a link class (parallel/topology.py) also bucket per class
+    (``donate:ici`` / ``donate:dcn``) — the fits the hierarchical steal
+    policy resolves its per-level quanta and periods from
+    (``steal_quantum`` / ``steal_every``).
+  * ``steal``    — intra-host worker ``steal`` spans: locked front-pop
+    + push duration vs stolen node count (the ``local`` link class).
 
 A profile entry is keyed by ``backend|topology|shape`` (e.g.
 ``tpu|device-D1|pfsp_j20x10_lb1``) so a ta014 fit never paces an N-Queens
@@ -60,10 +66,21 @@ _SPAN_LINKS = {
     "exchange": ("exchange", None),
     "donate_send": ("donate", "bytes"),
     "donate_recv": ("donate", "bytes"),
+    "steal": ("steal", "nodes"),
 }
 
 _X_UNITS = {"dispatch": "cycle", "offload": "node", "exchange": None,
-            "donate": "byte"}
+            "donate": "byte", "steal": "node"}
+
+#: Link classes a donate span may be stamped with (``args["link"]``,
+#: parallel/topology.py): stamped spans ALSO bucket into the per-class
+#: ``donate:ici`` / ``donate:dcn`` fits the hierarchical steal policy
+#: resolves its per-level quanta and periods from.
+_DONATE_CLASSES = ("ici", "dcn")
+
+#: Target amortization: a donation's transfer cost must stay below this
+#: fraction of the evaluation time the block buys (steal_quantum).
+DONATE_FRAC = 0.10
 
 
 def costmodel_path() -> str | None:
@@ -167,6 +184,14 @@ def samples_from_events(evts: list[dict]) -> dict[str, list]:
             if x is None:
                 continue
         links.setdefault(link, []).append((float(x), float(e["dur"])))
+        # Link-class-stamped donations additionally feed the per-class
+        # fits (donate:ici / donate:dcn) the steal hierarchy sizes its
+        # per-level quanta from; the aggregate "donate" bucket stays for
+        # older consumers.
+        if link == "donate" and args.get("link") in _DONATE_CLASSES:
+            links.setdefault(f"donate:{args['link']}", []).append(
+                (float(x), float(e["dur"]))
+            )
     return links
 
 
@@ -260,3 +285,60 @@ def exchange_sleep_s(entry: dict, cap_s: float = 0.5) -> float | None:
     if not p50 or p50 <= 0:
         return None
     return round(min(2.0 * p50 / 1e6, cap_s), 4)
+
+
+def donate_fit(entry: dict, link: str) -> dict | None:
+    """The donate fit for one link class: the stamped per-class fit
+    (``donate:ici`` / ``donate:dcn``) when the profile carries one, else
+    the aggregate ``donate`` fit (older profiles, single-class runs)."""
+    links = entry.get("links") or {}
+    fit = links.get(f"donate:{link}") or links.get("donate")
+    return fit if isinstance(fit, dict) else None
+
+
+def steal_quantum(entry: dict, link: str, *, m: int,
+                  bytes_per_node: int | None, cap: int,
+                  frac: float = DONATE_FRAC) -> int | None:
+    """Donation quantum (nodes) for ``link`` sized so the measured
+    transfer cost amortizes below ``frac`` of the evaluation time the
+    block buys:
+
+        lat_us + Q*bpn*per_byte_us  <=  frac * Q*eval_per_node_us
+        =>  Q >= lat_us / (frac*eval_per_node_us - bpn*per_byte_us)
+
+    ``eval_per_node_us`` is the offload (chunk) fit's slope — the
+    measured per-node evaluation cost on this backend. When the per-byte
+    transfer cost alone exceeds the amortization budget no finite quantum
+    qualifies; go maximally bulk (``cap``) to pay the latency as rarely
+    as possible. None (caller keeps the fixed fallback) without both a
+    donate-latency and an eval-rate fit. Clamped to [2m, cap] — a block
+    below 2m could not have been popped anyway (pop_front_bulk_half's
+    donor threshold)."""
+    fit = donate_fit(entry, link)
+    off = (entry.get("links") or {}).get("offload") or {}
+    eval_us = off.get("per_unit_us")
+    lat_us = (fit or {}).get("latency_us")
+    if not fit or not eval_us or eval_us <= 0 or not lat_us or lat_us <= 0:
+        return None
+    per_byte_us = fit.get("per_unit_us") or 0.0
+    xfer_per_node_us = (bytes_per_node or 0) * per_byte_us
+    denom = frac * eval_us - xfer_per_node_us
+    if denom <= 0:
+        return int(cap)
+    q = lat_us / denom
+    return int(min(max(q, 2 * m), cap))
+
+
+def steal_every(entry: dict, interval_s: float, *, cap: int = 32,
+                frac: float = DONATE_FRAC) -> int | None:
+    """Far-level period, in near-round multiples: far (dcn) pairs match
+    every ``N``-th exchange round where N spaces donations ~one latency
+    per ``1/frac`` latencies of elapsed time — the same amortization
+    target as the quantum, applied to the round cadence. None without a
+    donate-latency fit for the far link."""
+    fit = donate_fit(entry, "dcn")
+    lat_us = (fit or {}).get("latency_us")
+    if not fit or not lat_us or lat_us <= 0 or interval_s <= 0:
+        return None
+    n = (lat_us / 1e6) / (frac * interval_s)
+    return int(min(max(round(n), 2), cap))

@@ -129,6 +129,12 @@ def _aggregate(now: float, tier: str, last: list[dict], idle_count: int,
     for k in ("job", "cls"):
         if meta.get(k) is not None:
             snap_phase[k] = meta[k]
+    # Most recent steal's link class / hierarchy level (worker heartbeats
+    # or the inter-host communicator's note_steal): on a stall, this names
+    # the level the run was last fed from.
+    if meta.get("steal_link") is not None:
+        snap_phase["steal_link"] = meta["steal_link"]
+        snap_phase["steal_level"] = meta.get("steal_level")
     return {
         **snap_phase,
         "ts_us": now,
@@ -184,13 +190,18 @@ class FlightRecorder:
                   seq: int = 0, cycles: int = 0, size: int | None = None,
                   best: int | None = None, tree: int = 0, sol: int = 0,
                   depth: int = 1, K: int | None = None, inflight: int = 0,
-                  steals: int = 0, phases: dict | None = None) -> None:
+                  steals: int = 0, phases: dict | None = None,
+                  steal_link: str | None = None,
+                  steal_level: int | None = None) -> None:
         """One completed dispatch/chunk boundary. Updates the registry,
         feeds the watchdog, and (rate-limited) appends a ring snapshot +
         emits a ``snapshot`` counter sample into the event stream.
         ``phases`` is the run's per-phase ns totals so far (TTS_PHASEPROF
         armed runs) — a watchdog post-mortem then names where the last
-        dispatch was spending its cycles."""
+        dispatch was spending its cycles. ``steal_link``/``steal_level``
+        name the worker's most recent steal's link class and hierarchy
+        level (parallel/topology.py) so a stalled run's snapshot shows
+        which steal level it was living off."""
         if not (self.always_on or enabled()):
             return
         now = ev.now_us()
@@ -204,6 +215,9 @@ class FlightRecorder:
             }
             if phases is not None:
                 entry["phases"] = dict(phases)
+            if steal_link is not None:
+                self._meta["steal_link"] = steal_link
+                self._meta["steal_level"] = steal_level
             self._last[(host, wid)] = entry
             self._idle.discard((host, wid))
             self._meta.setdefault("tier", tier)
@@ -233,6 +247,17 @@ class FlightRecorder:
                 self._idle.add((host, wid))
             else:
                 self._idle.discard((host, wid))
+
+    def note_steal(self, host: int, link: str, level: int) -> None:
+        """Record a work-migration arrival's link class / hierarchy level
+        without a full heartbeat — the inter-host communicator thread's
+        call site (dist/dist_mesh donation receive): the next snapshot
+        (and a stall post-mortem) then names the level feeding the run."""
+        if not (self.always_on or enabled()):
+            return
+        with self._lock:
+            self._meta["steal_link"] = link
+            self._meta["steal_level"] = level
 
     def snapshots(self, n: int | None = None) -> list[dict]:
         with self._lock:
@@ -449,6 +474,10 @@ def heartbeat(*args, **kw) -> None:
 
 def set_idle(host: int, wid: int, idle: bool) -> None:
     current().set_idle(host, wid, idle)
+
+
+def note_steal(host: int, link: str, level: int) -> None:
+    current().note_steal(host, link, level)
 
 
 def snapshots(n: int | None = None) -> list[dict]:

@@ -226,6 +226,14 @@ def format_snapshot(snap: dict) -> str:
         )
     if snap.get("steals"):
         parts.append(f"steals={snap['steals']}")
+    if snap.get("steal_link"):
+        # Hierarchical stealing (TTS_STEAL=hier): which link class last
+        # fed this run — on a stall, the level the search was living off.
+        lvl = snap.get("steal_level")
+        parts.append(
+            f"steal={snap['steal_link']}"
+            + (f"/L{lvl}" if lvl is not None else "")
+        )
     if snap.get("dominant_phase"):
         # TTS_PHASEPROF runs: where the last dispatch spent its cycles.
         share = snap.get("dominant_phase_share", 0.0)

@@ -57,6 +57,62 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
         "exchange_rounds": rounds,
     }
 
+    # -- per-link-class steal table (TTS_STEAL, parallel/topology.py) ------
+    # Steal-path events stamp their link class (local / ici / dcn) and
+    # hierarchy level; bucketing them per class is the observable form of
+    # the two-level policy. Acquisition cost is the span duration of the
+    # events that DELIVER work (local steal + donate_recv) — the same
+    # samples the cost model's steal/donate fits consume; donate_send
+    # counts as the inter-host attempt. Traces predating the stamps (no
+    # "link" arg) simply produce an empty table.
+    steal_links: dict = {}
+
+    def _link_bucket(link: str) -> dict:
+        return steal_links.setdefault(link, {
+            "attempts": 0, "hits": 0, "misses": 0,
+            "nodes": 0, "bytes": 0, "_cost_us": 0.0, "_cost_n": 0,
+        })
+
+    for e in steals:
+        a = e.get("args") or {}
+        if a.get("link") is None:
+            continue
+        b = _link_bucket(a["link"])
+        b["attempts"] += 1
+        b["hits"] += 1
+        b["nodes"] += a.get("nodes", 0)
+        b["bytes"] += a.get("bytes", 0)
+        if "dur" in e:
+            b["_cost_us"] += e["dur"]
+            b["_cost_n"] += 1
+    for e in misses:
+        a = e.get("args") or {}
+        if a.get("link") is None:
+            continue
+        b = _link_bucket(a["link"])
+        b["attempts"] += 1
+        b["misses"] += 1
+    for e in sends:
+        a = e.get("args") or {}
+        if a.get("link") is None:
+            continue
+        _link_bucket(a["link"])["attempts"] += 1
+    for e in recvs:
+        a = e.get("args") or {}
+        if a.get("link") is None:
+            continue
+        b = _link_bucket(a["link"])
+        b["hits"] += 1
+        b["nodes"] += a.get("nodes", 0)
+        b["bytes"] += a.get("bytes", 0)
+        if "dur" in e:
+            b["_cost_us"] += e["dur"]
+            b["_cost_n"] += 1
+    for b in steal_links.values():
+        n = b.pop("_cost_n")
+        us = b.pop("_cost_us")
+        b["mean_cost_us"] = round(us / n, 1) if n else None
+
     # -- idle fraction per worker -----------------------------------------
     # Busy time is the UNION of dispatch/chunk spans, not their sum: under
     # pipelined dispatch (TTS_PIPELINE >= 2) a track carries up to `depth`
@@ -247,6 +303,7 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
         "span_s": round(span_s, 6),
         "hosts": len({e.get("pid", 0) for e in evts}),
         "steal": steal,
+        "steal_links": steal_links,
         "idle": idle,
         "cycle_rate": timeline,
         "device_counters": counters_total,
@@ -319,6 +376,20 @@ def render(summary: dict) -> str:
         f"{s['interhost_blocks_sent']} block(s) / "
         f"{s['interhost_nodes_sent']} node(s) donated"
     )
+    if summary.get("steal_links"):
+        # Cheapest link class first — the victim-selection escalation
+        # order of the hierarchical policy (parallel/topology.py).
+        order = {"local": 0, "ici": 1, "dcn": 2}
+        out.append("steal table per link class:")
+        for link, b in sorted(summary["steal_links"].items(),
+                              key=lambda kv: (order.get(kv[0], 9), kv[0])):
+            mc = (f"{b['mean_cost_us']:,.0f}us"
+                  if b["mean_cost_us"] is not None else "-")
+            out.append(
+                f"  {link:<6} attempts={b['attempts']} hits={b['hits']} "
+                f"misses={b['misses']} nodes={b['nodes']} "
+                f"bytes={b['bytes']} mean_cost={mc}"
+            )
     out.append("idle fraction per worker:")
     if summary["idle"]:
         for key, w in summary["idle"].items():

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..engine.results import SearchResult
 from ..obs import events as ev
+from ..obs import flightrec as fr
 from ..pool import ParallelSoAPool
 from ..problems.base import Problem
 from .multidevice import host_pipeline
@@ -281,6 +282,16 @@ class _HostComm:
     When every host is busy and none is needy, the exchange cadence backs
     off geometrically (up to 16x ``interval_s``) and resets the moment any
     host reports need — a balanced run pays almost no collective overhead.
+
+    Under ``TTS_STEAL=hier`` (parallel/topology.py) the matching in step 3
+    becomes two-level: near (intra-pod ICI) donor->needy pairs every
+    round with the near quantum, far (inter-pod DCN) pairs only every
+    ``far_every``-th round — and only for needy hosts the near level
+    could not feed — with the bulk far quantum. The round counter
+    advances in lockstep, so the level schedule is identical on every
+    host and the flat policy's no-handshake property is preserved.
+    ``TTS_STEAL=flat`` (default) keeps the single-level matching above
+    byte/behavior-identical.
     """
 
     #: kv_get wait for a matched donation (donor is alive and popping from
@@ -290,7 +301,9 @@ class _HostComm:
 
     def __init__(self, collectives, m: int, perc: float = 0.5,
                  interval_s: float = 0.02, M: int = 50000,
-                 ckpt_interval_s: float = 60.0):
+                 ckpt_interval_s: float = 60.0, policy=None):
+        from .topology import StealPolicy, Topology
+
         self.coll = collectives
         # Captured here (construction happens on the bound host thread):
         # ThreadCollectives.host_id is thread-local and the communicator
@@ -300,6 +313,10 @@ class _HostComm:
         self.M = M
         self.perc = perc
         self.interval_s = interval_s
+        self.policy = policy or StealPolicy(
+            mode="flat", topology=Topology(collectives.num_hosts), m=m,
+            cap=M, interval_s=interval_s,
+        )
         self.rounds = 0
         self.blocks_sent = 0
         self.blocks_received = 0
@@ -326,12 +343,13 @@ class _HostComm:
 
         self._run_uuid = _uuid.uuid4().hex[:12]
 
-    def _donate_from(self, pools: list[ParallelSoAPool]):
+    def _donate_from(self, pools: list[ParallelSoAPool], cap: int | None = None):
         """Locked front-steal from the fullest local pool (on behalf of a
         remote host); None when no pool can spare a block. Blocks are capped
-        at M nodes so a huge pool never ships an unbounded payload over DCN
-        (the reference steals perc-of-pool uncapped, `Pool_ext.c:138-151`;
-        the mesh tier here caps donations — same policy)."""
+        (M nodes flat; the link-class quantum under hier) so a huge pool
+        never ships an unbounded payload over DCN (the reference steals
+        perc-of-pool uncapped, `Pool_ext.c:138-151`; the mesh tier here
+        caps donations — same policy)."""
         # (No waiver needed: guarded-by does not descend into lambda
         # bodies, so the advisory racy read in the key fn is out of its
         # scope — the pop below re-checks size under try_lock anyway.)
@@ -342,7 +360,7 @@ class _HostComm:
         if victim.try_lock():
             try:
                 return victim.pop_front_bulk_half(
-                    self.m, self.perc, cap=self.M
+                    self.m, self.perc, cap=self.M if cap is None else cap
                 )
             finally:
                 victim.unlock()
@@ -447,7 +465,15 @@ class _HostComm:
                 (h for h in range(H) if idles[h] and sizes[h] < self.m),
                 key=lambda h: (sizes[h], h),
             )
-            pairs = list(zip(donors, needy))
+            if self.policy.hier:
+                # Two-level topology-aware matching: near (ici) pairs
+                # every round, far (dcn) pairs only on far rounds and
+                # only for needy the near level missed — deterministic on
+                # the lockstep round counter (parallel/topology.py).
+                pairs = self.policy.match(donors, needy, self.rounds,
+                                          sizes=maxes)
+            else:
+                pairs = list(zip(donors, needy))
             if not pairs:
                 if all(idles) and max(maxes) < 2 * self.m:
                     # Global quiescence candidate: every host idle, no pool
@@ -481,12 +507,20 @@ class _HostComm:
                 send_to = next((r for d, r in pairs if d == me), None)
                 recv_from = next((d for d, r in pairs if r == me), None)
                 if send_to is not None:
-                    payload = self._donate_from(pools)
+                    link = self.policy.link(me, send_to)
+                    payload = self._donate_from(
+                        pools, cap=self.policy.cap_for(link)
+                    )
                     self._inflight = payload
                     blob = pickle.dumps(payload)
                     # Donation SPAN over the KV put (bytes + duration: the
-                    # "donate" bandwidth sample of the cost model).
+                    # "donate"/"donate:<link>" bandwidth samples of the
+                    # cost model). The simulated-latency harness sleeps
+                    # INSIDE the span so injected link latencies land in
+                    # the measured fit (zero sleeps unless TTS_SIM_LAT_*
+                    # is armed).
                     t_d = ev.now_us()
+                    self.policy.sim.sleep(link)
                     coll.kv_set(
                         f"tts/steal/{self.rounds}/{me}->{send_to}", blob
                     )
@@ -499,8 +533,11 @@ class _HostComm:
                                     args={"peer": send_to,
                                           "nodes": batch_length(payload),
                                           "bytes": len(blob),
+                                          "link": link,
+                                          "level": self.policy.level_of(link),
                                           "round": self.rounds})
                 if recv_from is not None:
+                    link = self.policy.link(recv_from, me)
                     t_d = ev.now_us()
                     raw = coll.kv_get(
                         f"tts/steal/{self.rounds}/{recv_from}->{me}",
@@ -514,6 +551,8 @@ class _HostComm:
                                     args={"peer": recv_from,
                                           "nodes": batch_length(batch),
                                           "bytes": len(raw),
+                                          "link": link,
+                                          "level": self.policy.level_of(link),
                                           "round": self.rounds})
                         # Whole block into one local pool (keeps it >= m so
                         # the receiving worker can pop; intra-host stealing
@@ -522,6 +561,7 @@ class _HostComm:
                         rrobin = (rrobin + 1) % len(pools)
                         self.blocks_received += 1
                         self.nodes_received += batch_length(batch)
+                        fr.note_steal(me, link, self.policy.level_of(link))
             if do_ckpt:
                 # Same round on every host (rows[0][4]): donations above
                 # completed, workers pause at chunk boundaries, each host
@@ -558,6 +598,7 @@ def _host_search(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 60.0,
     resume_from: str | None = None,
+    topology=None,
 ):
     """One host's full pipeline (warm-up + stride slice, local multi-device
     runtime with an inter-host communicator, local drain); returns its local
@@ -568,10 +609,23 @@ def _host_search(
     or on independent timers when ``steal=False`` (no inter-host traffic
     exists to straddle an unsynchronized cut)."""
     comm = None
+    policy = None
     if steal and collectives.num_hosts > 1:
+        import jax
+
+        from .topology import Topology, resolve_policy
+
+        topo = topology or Topology.detect(collectives.num_hosts)
+        # Resolved from env + the (shared) profile file only — every host
+        # lands on the identical policy without communication.
+        policy = resolve_policy(
+            problem, topo, m=m, cap=M, interval_s=steal_interval_s,
+            backend=jax.default_backend(),
+            topo_str=f"dist-H{collectives.num_hosts}xD{D}",
+        )
         comm = _HostComm(
             collectives, m, perc=perc, interval_s=steal_interval_s, M=M,
-            ckpt_interval_s=checkpoint_interval_s,
+            ckpt_interval_s=checkpoint_interval_s, policy=policy,
         )
     local = host_pipeline(
         problem, m, M, D, devices,
@@ -591,6 +645,8 @@ def _host_search(
             "nodes_sent": comm.nodes_sent,
             "nodes_received": comm.nodes_received,
         }
+    if policy is not None:
+        local["steal_policy"] = policy.describe()
     return local
 
 
@@ -617,6 +673,7 @@ def _reduce(local: dict, collectives) -> SearchResult:
         per_worker_tree=local["per_worker_tree"],
         steals=steals,
         comm=comm,
+        steal_policy=local.get("steal_policy"),
     )
 
 
@@ -657,6 +714,17 @@ def dist_search(
         local_devices = jax.local_devices() if devices is None else devices
         if D is None:
             D = len(local_devices)
+        # Real pods: the pod map comes from each process's slice index,
+        # allgathered once (multi-slice deployments put ICI inside a slice
+        # and DCN between slices); TTS_PODS still wins inside detect().
+        from .topology import Topology
+
+        slice_idx = getattr(local_devices[0], "slice_index", None) \
+            if (steal and local_devices) else None
+        topo = Topology.detect(
+            coll.num_hosts, slice_index=slice_idx,
+            allgather=coll.allgather_obj if slice_idx is not None else None,
+        )
         local = _host_search(
             problem, m, M, D, local_devices, coll, initial_best, share_bound,
             steal=steal, steal_interval_s=steal_interval_s, perc=perc,
@@ -664,6 +732,7 @@ def dist_search(
             checkpoint_path=checkpoint_path,
             checkpoint_interval_s=checkpoint_interval_s,
             resume_from=resume_from,
+            topology=topo,
         )
         return _reduce(local, coll)
 

@@ -163,6 +163,28 @@ def _host_loop(
         measured_sleep = cm.exchange_sleep_s(hit[1]) if hit else None
         if measured_sleep is not None:
             exchange_sleep_s = measured_sleep
+    # Steal policy (TTS_STEAL, parallel/topology.py): flat keeps this
+    # tier's single-level donor->needy matching byte-identical; hier
+    # layers the near/far schedule over the same lockstep rounds. The
+    # exchange period here IS the dispatch cadence, so the far-period
+    # resolution uses the adaptive-K target band's midpoint as the base
+    # interval (or the measured idle back-off when one resolved).
+    policy = None
+    if H > 1:
+        from .topology import Topology, resolve_policy
+
+        dev0 = next(iter(mesh.devices.flat), None)
+        slice_idx = getattr(dev0, "slice_index", None)
+        topo = Topology.detect(
+            H, slice_index=slice_idx,
+            allgather=coll.allgather_obj if slice_idx is not None else None,
+        )
+        policy = resolve_policy(
+            problem, topo, m=m, cap=D * M,
+            interval_s=exchange_sleep_s or (band[0] + band[1]) / 2.0,
+            backend=jax.default_backend(),
+            topo_str=f"dist_mesh-H{H}xD{D}",
+        )
     ctl = AdaptiveK(k_value, target=band) if k_auto else None
     depth = resolve_pipeline_depth()
     program = get_mesh_program(problem, mesh, m, M,
@@ -409,7 +431,17 @@ def _host_loop(
             (h for h in range(H) if idles[h]),
             key=lambda h: (totals[h], h),
         )
-        pairs = [(d, r) for d, r in zip(donors, needy) if d != r]
+        if policy is not None and policy.hier:
+            # Two-level matching (topology.py): near pairs every round,
+            # far pairs on far rounds for near-unmatched needy only. Same
+            # allgathered inputs + same round counter on every host ->
+            # identical pairs, exactly like the flat zip.
+            pairs = [(d, r)
+                     for d, r in policy.match(donors, needy, exch_rounds,
+                                              sizes=totals)
+                     if d != r]
+        else:
+            pairs = [(d, r) for d, r in zip(donors, needy) if d != r]
         if all(idles) and not pairs:
             quiescent_streak += 1
             if quiescent_streak >= 2:
@@ -425,16 +457,22 @@ def _host_loop(
             # shallowest — `Pool_par.chpl:180-191`) capped at D*M nodes,
             # re-upload the rest. One transfer each way, only on donation
             # rounds.
+            link = policy.link(me, send_to)
             p = download()
             # Steal-half-from-front policy, capped (the dist tier's bounded
             # donation: a huge frontier never ships unbounded over DCN).
-            block = p.pop_front_bulk_half(m, 0.5, cap=D * M)
+            # Flat cap is the legacy D*M; hier caps per link class so far
+            # links ship their resolved bulk quantum.
+            block = p.pop_front_bulk_half(m, 0.5, cap=policy.cap_for(link))
             blob = pickle.dumps(block)
             # Donation SPAN over the KV put alone (bytes + duration — the
             # "donate" bandwidth sample of the cost model); the frontier
             # download/re-upload around it is charged to the donor's own
-            # dispatch gap, not the link.
+            # dispatch gap, not the link. The simulated link latency
+            # (TTS_SIM_LAT_*) sleeps INSIDE the span so injected latency
+            # lands in the measured donate:{link} fit.
             t_d = ev.now_us()
+            policy.sim.sleep(link)
             coll.kv_set(
                 f"tts/dmesh/{exch_rounds}/{me}->{send_to}", blob
             )
@@ -445,9 +483,12 @@ def _host_loop(
                             args={"peer": send_to,
                                   "nodes": batch_length(block),
                                   "bytes": len(blob),
-                                  "round": exch_rounds})
+                                  "round": exch_rounds,
+                                  "link": link,
+                                  "level": policy.level_of(link)})
             upload(p)
         if recv_from is not None:
+            link = policy.link(recv_from, me)
             t_d = ev.now_us()
             raw = coll.kv_get(
                 f"tts/dmesh/{exch_rounds}/{recv_from}->{me}",
@@ -461,12 +502,15 @@ def _host_loop(
                             args={"peer": recv_from,
                                   "nodes": batch_length(block),
                                   "bytes": len(raw),
-                                  "round": exch_rounds})
+                                  "round": exch_rounds,
+                                  "link": link,
+                                  "level": policy.level_of(link)})
                 p = download()
                 p.push_back_bulk(block)
                 upload(p)
                 blocks_received += 1
                 nodes_received += batch_length(block)
+                fr.note_steal(me, link, policy.level_of(link))
         if idle and recv_from is None and exchange_sleep_s:
             time.sleep(exchange_sleep_s)
 
@@ -505,6 +549,9 @@ def _host_loop(
             "nodes_sent": nodes_sent,
             "nodes_received": nodes_received,
         },
+        # Resolved steal policy (identical on every host — env + profile
+        # resolution only); None below the exchange threshold (H == 1).
+        "steal_policy": policy.describe() if policy is not None else None,
         "complete": completed,
         # Survivor-path mode the per-host SPMD step baked in (identical on
         # every host: same knob, same problem shape, same device platform).
@@ -544,6 +591,7 @@ def _reduce(local: dict, coll) -> SearchResult:
         per_worker_tree=local["per_worker_tree"],
         steals=coll.allreduce_sum(local["steals"]),
         comm=comm,
+        steal_policy=local.get("steal_policy"),
         complete=bool(coll.allreduce_min(int(local["complete"]))),
         compact=local.get("compact"),
         compact_auto=local.get("compact_auto", False),

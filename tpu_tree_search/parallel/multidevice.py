@@ -216,6 +216,11 @@ class _Worker:
         self.best = INF_BOUND
         self.steals = 0
         self.chunks = 0  # completed-chunk sequence (flight recorder)
+        # Last successful steal's link class / hierarchy level (intra-host
+        # steals are always local/0; carried on heartbeats so `tts watch`
+        # can name the current steal level — parallel/topology.py).
+        self.steal_link: str | None = None
+        self.steal_level: int | None = None
         self.diagnostics = Diagnostics()
         self.error: BaseException | None = None
 
@@ -282,7 +287,8 @@ def _worker_loop(
                               "sol": res.sol_inc})
             fr.heartbeat("multi", host=host_id, wid=w.wid, seq=w.chunks,
                          best=w.best, tree=w.tree, sol=w.sol,
-                         steals=w.steals)
+                         steals=w.steals, steal_link=w.steal_link,
+                         steal_level=w.steal_level)
 
         while True:
             if gate is not None:
@@ -321,7 +327,11 @@ def _worker_loop(
                 consume_pending()
                 continue
             # -- work stealing (`pfsp_multigpu_chpl.chpl:438-479`) ---------
+            # Timed as a SPAN (victim scan + locked pop + push): the cost
+            # model's "steal" link — the local-class latency the steal
+            # hierarchy compares against ici/dcn donation fits.
             stolen = False
+            t_steal = ev.now_us()
             for victim_id in rng.permutation(D):
                 if victim_id == w.wid:
                     continue
@@ -335,10 +345,16 @@ def _worker_loop(
                         if batch is not None:
                             w.pool.locked_push_back_bulk(batch)
                             w.steals += 1
+                            w.steal_link, w.steal_level = "local", 0
                             stolen = True
-                            ev.emit("steal", wid=w.wid, host=host_id,
-                                    args={"victim": int(victim_id),
-                                          "nodes": batch_length(batch)})
+                            ev.complete("steal", t_steal, wid=w.wid,
+                                        host=host_id,
+                                        args={"victim": int(victim_id),
+                                              "nodes": batch_length(batch),
+                                              "bytes": sum(
+                                                  a.nbytes
+                                                  for a in batch.values()),
+                                              "link": "local", "level": 0})
                         break
                     time.sleep(0)  # yieldExecution backoff
                 if stolen:
@@ -352,7 +368,8 @@ def _worker_loop(
                 # One miss per busy->idle transition, not per spin
                 # iteration: the termination loop re-scans victims every
                 # few microseconds and would flood the trace.
-                ev.emit("steal_miss", wid=w.wid, host=host_id)
+                ev.emit("steal_miss", wid=w.wid, host=host_id,
+                        args={"link": "local", "level": 0})
                 idle_t0 = ev.now_us()
                 fr.set_idle(host_id, w.wid, True)
             if stop_event is not None:

@@ -1,0 +1,356 @@
+"""Topology descriptors, link classes, and the hierarchical steal policy.
+
+The reference's distributed tier applies ONE flat steal policy to every
+link — the same period and the same block cap whether the victim sits on
+the same host, one ICI hop away, or across a DCN boundary. The paper's own
+scaling story (and arXiv:0809.3285 / arXiv:1904.06825, PAPERS.md) says the
+profitable steal period and steal *size* differ by an order of magnitude
+between those links. This module makes work distribution topology-aware:
+
+  * **Link classes.** Every worker pair classifies as ``local`` (same
+    host, device<->device through host RAM), ``ici`` (different host,
+    same pod/slice), or ``dcn`` (across pods). The pod map comes from
+    ``TTS_PODS`` (virtual hosts / explicit deployments) or from jax's
+    per-process slice index allgathered once at startup (real pods);
+    with neither, every host shares pod 0 and all inter-host links are
+    ``ici``.
+  * **Two-level hierarchy** (``TTS_STEAL=hier``): the lockstep exchange
+    round stays global (the matching must be identical on every host —
+    no handshake), but near (ici) donor->needy pairs are matched **every**
+    round with a small quantum, while far (dcn) pairs are matched only
+    every ``far_every``-th round — and only for needy hosts the near level
+    failed to feed — with a **bulk** quantum sized so the measured
+    transfer cost (latency + bytes/bandwidth fit from COSTMODEL.json,
+    obs/costmodel.py) amortizes below a target fraction of the evaluation
+    time the block buys. ``TTS_STEAL=flat`` (the default) keeps today's
+    single-level matching byte/behavior-identical.
+  * **Simulated links.** ``TTS_SIM_LAT_ICI`` / ``TTS_SIM_LAT_DCN``
+    (seconds) inject a one-way latency on the donation path of the
+    matching link class — the virtual-host analogue of the simulated-
+    latency harness in tests/test_pipeline.py. Unset means zero sleeps:
+    production behavior is untouched.
+
+The knob is host-side only — no compiled program ever sees it (pinned by
+the ``steal-knob-inert`` contract below, ``tts check``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+from ..analysis.contracts import contract
+
+#: Link classes, cheapest first (the victim-selection escalation order).
+LINK_LOCAL = "local"
+LINK_ICI = "ici"
+LINK_DCN = "dcn"
+LINK_CLASSES = (LINK_LOCAL, LINK_ICI, LINK_DCN)
+
+#: Fixed fallbacks when no cost-model fit exists (documented in
+#: docs/PARALLELISM.md): far (dcn) rounds fire every 4th near round, and
+#: the far quantum is 8x the near cap — infrequent bulk donations vs the
+#: near level's frequent small blocks.
+FAR_EVERY_DEFAULT = 4
+FAR_QUANTUM_MULT = 8
+FAR_EVERY_MAX = 32
+
+
+def steal_mode() -> str:
+    """The ``TTS_STEAL`` knob: ``flat`` (default, today's single-level
+    policy) or ``hier`` (two-level topology-aware matching). Unrecognized
+    values fall back to flat — a typo must never change semantics."""
+    raw = (os.environ.get("TTS_STEAL", "") or "").strip().lower()
+    return "hier" if raw == "hier" else "flat"
+
+
+def _parse_pods(raw: str, num_hosts: int) -> list[int] | None:
+    """``TTS_PODS`` grammar: an integer K splits hosts into K contiguous
+    equal pods (``TTS_PODS=2`` with H=4 -> [0,0,1,1]); a comma list gives
+    the pod id per host (``TTS_PODS=0,0,1,1``). None on any mismatch."""
+    raw = (raw or "").strip()
+    if not raw:
+        return None
+    try:
+        if "," in raw:
+            pods = [int(x) for x in raw.split(",")]
+            return pods if len(pods) == num_hosts else None
+        k = int(raw)
+        if k <= 0:
+            return None
+        per = max(1, (num_hosts + k - 1) // k)
+        return [min(h // per, k - 1) for h in range(num_hosts)]
+    except ValueError:
+        return None
+
+
+class Topology:
+    """Host->pod map + pairwise link classification for H hosts."""
+
+    def __init__(self, num_hosts: int, pod_of: list[int] | None = None):
+        self.num_hosts = num_hosts
+        self.pod_of = list(pod_of) if pod_of else [0] * num_hosts
+
+    @classmethod
+    def detect(cls, num_hosts: int, slice_index: int | None = None,
+               allgather=None) -> "Topology":
+        """Build the pod map: ``TTS_PODS`` wins (virtual hosts, explicit
+        deployments); else, when the caller supplies its jax slice index
+        and an allgather, the real multi-slice map is assembled once over
+        the collectives; else one pod."""
+        pods = _parse_pods(os.environ.get("TTS_PODS", ""), num_hosts)
+        if pods is None and slice_index is not None and allgather is not None:
+            gathered = allgather(int(slice_index))
+            if len(gathered) == num_hosts:
+                pods = [int(p) for p in gathered]
+        return cls(num_hosts, pods)
+
+    def link_class(self, a: int, b: int) -> str:
+        """Link class between hosts ``a`` and ``b`` (ISSUE taxonomy:
+        intra-host device<->device, intra-pod ICI, inter-pod DCN)."""
+        if a == b:
+            return LINK_LOCAL
+        return LINK_ICI if self.pod_of[a] == self.pod_of[b] else LINK_DCN
+
+    @property
+    def num_pods(self) -> int:
+        return len(set(self.pod_of))
+
+    def describe(self) -> dict:
+        return {"num_hosts": self.num_hosts, "pods": list(self.pod_of)}
+
+
+class SimLinks:
+    """Env-armed one-way link-latency injection for the simulated-latency
+    harness (CPU A/B at virtual-host scale). A sleep fires on the donation
+    path of the matching link class only when the knob is set — unset means
+    ``armed`` is False and callers skip the call sites entirely."""
+
+    def __init__(self):
+        self.lat_s = {}
+        for link, knob in ((LINK_ICI, "TTS_SIM_LAT_ICI"),
+                           (LINK_DCN, "TTS_SIM_LAT_DCN")):
+            try:
+                v = float(os.environ.get(knob, "") or 0.0)
+            except ValueError:
+                v = 0.0
+            if v > 0:
+                self.lat_s[link] = v
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.lat_s)
+
+    def sleep(self, link: str) -> None:
+        lat = self.lat_s.get(link, 0.0)
+        if lat > 0:
+            time.sleep(lat)
+
+
+@dataclass
+class LevelSpec:
+    """Resolved parameters for one hierarchy level."""
+
+    link: str        # "ici" | "dcn"
+    level: int       # 1 = near, 2 = far
+    every: int       # match this link class every `every`-th exchange round
+    quantum: int     # donation block cap (nodes)
+    period_s: float  # resolved steal period (every * base interval)
+    source: str      # "fixed" or the COSTMODEL.json profile key
+
+
+@dataclass
+class StealPolicy:
+    """The resolved steal policy threaded through dist/dist_mesh/multi.
+
+    ``flat`` mode carries only the legacy parameters (cap = M every round
+    on every link) so the communicators' flat paths stay byte/behavior-
+    identical; ``hier`` mode adds per-level periods and quanta plus the
+    near-first/escalate-far matching below."""
+
+    mode: str
+    topology: Topology
+    m: int
+    cap: int                       # legacy flat cap (M / D*M)
+    interval_s: float
+    levels: dict = field(default_factory=dict)  # link -> LevelSpec
+    sim: SimLinks = field(default_factory=SimLinks)
+
+    @property
+    def hier(self) -> bool:
+        return self.mode == "hier"
+
+    def link(self, a: int, b: int) -> str:
+        return self.topology.link_class(a, b)
+
+    def cap_for(self, link: str) -> int:
+        if not self.hier:
+            return self.cap
+        spec = self.levels.get(link)
+        return spec.quantum if spec is not None else self.cap
+
+    def level_of(self, link: str) -> int:
+        spec = self.levels.get(link)
+        return spec.level if spec is not None else (0 if link == LINK_LOCAL
+                                                    else 1)
+
+    def match(self, donors: list[int], needy: list[int], round_no: int,
+              sizes: list[int] | None = None) -> list[tuple[int, int]]:
+        """Deterministic two-level matching (identical inputs on every
+        host -> identical pairs, no handshake — the flat policy's key
+        property, kept). Near (ici) pairs every round; far (dcn) pairs
+        only on far rounds and only for needy hosts the near level left
+        unmatched — victim selection prefers the cheapest link class and
+        escalates outward only after local misses.
+
+        ``sizes`` (the allgathered per-host donatable sizes, when the
+        caller has them) arms the far **amortization floor**: a far
+        donation pays the full link latency whatever it carries, so a
+        donor qualifies for a far pair only when its pool can fill a
+        meaningful fraction of the bulk quantum — shipping end-of-run
+        scraps across the expensive link is exactly the waste the
+        two-level policy exists to avoid."""
+        far_spec = self.levels.get(LINK_DCN)
+        far_round = far_spec is None or round_no % max(1, far_spec.every) == 0
+        far_floor = 0
+        if far_spec is not None and sizes is not None:
+            far_floor = max(4 * self.m, far_spec.quantum // 2)
+        pairs: list[tuple[int, int]] = []
+        free = list(donors)
+        unmatched = []
+        for r in needy:
+            near = next((d for d in free if self.link(d, r) == LINK_ICI), None)
+            if near is not None:
+                pairs.append((near, r))
+                free.remove(near)
+            else:
+                unmatched.append(r)
+        if far_round:
+            for r in unmatched:
+                far = next(
+                    (d for d in free
+                     if self.link(d, r) == LINK_DCN
+                     and (sizes is None or sizes[d] >= far_floor)),
+                    None,
+                )
+                if far is not None:
+                    pairs.append((far, r))
+                    free.remove(far)
+        return pairs
+
+    def describe(self) -> dict:
+        """The surfaced policy (SearchResult.steal_policy, ``--json``,
+        banner): mode + per-link-class resolved periods and quanta."""
+        out = {"mode": self.mode, "pods": list(self.topology.pod_of)}
+        if self.hier:
+            out["levels"] = {
+                link: {
+                    "level": s.level,
+                    "every": s.every,
+                    "period_s": round(s.period_s, 4),
+                    "quantum": s.quantum,
+                    "source": s.source,
+                }
+                for link, s in sorted(self.levels.items())
+            }
+        else:
+            out["levels"] = {
+                "any": {"level": 1, "every": 1,
+                        "period_s": round(self.interval_s, 4),
+                        "quantum": self.cap, "source": "fixed"},
+            }
+        if self.sim.armed:
+            out["sim_lat_s"] = dict(sorted(self.sim.lat_s.items()))
+        return out
+
+
+def bytes_per_node(problem) -> int | None:
+    """Per-node payload size from the SoA schema — converts the cost
+    model's per-byte donate slope into per-node terms for quantum sizing."""
+    try:
+        import numpy as np
+
+        total = 0
+        for _, (shape, dtype) in problem.node_fields().items():
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * np.dtype(dtype).itemsize
+        return total or None
+    except Exception:
+        return None
+
+
+def resolve_policy(problem, topology: Topology, *, m: int, cap: int,
+                   interval_s: float, mode: str | None = None,
+                   backend: str = "cpu", topo_str: str = "",
+                   ) -> StealPolicy:
+    """Build the policy for one search: flat unless ``TTS_STEAL=hier``.
+
+    Hier levels resolve from the measured COSTMODEL.json fits when
+    ``TTS_COSTMODEL`` is armed (obs/costmodel.py ``steal_quantum`` /
+    ``steal_every``); the documented fixed fallbacks otherwise. Resolution
+    uses only env + the profile file, so every host resolves the same
+    policy without communication."""
+    from ..obs import costmodel as cm
+
+    mode = mode or steal_mode()
+    policy = StealPolicy(mode=mode, topology=topology, m=m, cap=cap,
+                         interval_s=interval_s)
+    if mode != "hier":
+        return policy
+    entry, src = None, "fixed"
+    path = cm.costmodel_path()
+    if path:
+        prof = cm.load(path)
+        if prof:
+            hit = cm.lookup(prof, backend, topo_str, cm.shape_class(problem))
+            if hit is not None:
+                src, entry = hit
+    bpn = bytes_per_node(problem)
+    near_q = cap
+    far_q = min(cap * FAR_QUANTUM_MULT, max(cap, 2 ** 20))
+    far_every = FAR_EVERY_DEFAULT
+    near_src = far_src = "fixed"
+    if entry is not None:
+        q = cm.steal_quantum(entry, LINK_ICI, m=m, bytes_per_node=bpn,
+                             cap=near_q * FAR_QUANTUM_MULT)
+        if q is not None:
+            near_q, near_src = q, src
+        q = cm.steal_quantum(entry, LINK_DCN, m=m, bytes_per_node=bpn,
+                             cap=far_q)
+        if q is not None:
+            far_q, far_src = max(q, near_q), src
+        ev_ = cm.steal_every(entry, interval_s, cap=FAR_EVERY_MAX)
+        if ev_ is not None:
+            far_every = ev_
+    policy.levels = {
+        LINK_ICI: LevelSpec(LINK_ICI, 1, 1, near_q, interval_s, near_src),
+        LINK_DCN: LevelSpec(LINK_DCN, 2, far_every, far_q,
+                            interval_s * far_every, far_src),
+    }
+    return policy
+
+
+# -- tts check contract -------------------------------------------------------
+# TTS_STEAL is a pure host-side scheduling knob: the traced resident
+# program must be byte-identical across off/flat/hier (the knob-inert
+# family — engine/pipeline.py's TTS_PIPELINE precedent).
+
+
+@contract(
+    "steal-knob-inert",
+    claim="TTS_STEAL never reaches compiled programs: flat and hier trace "
+          "byte-identical jaxprs vs the unset baseline",
+    artifact="variants",
+)
+def _contract_steal_inert(art, cell):
+    if not art.has("off", "steal-flat", "steal-hier"):
+        return []
+    if art.text("off") == art.text("steal-flat") == art.text("steal-hier"):
+        return []
+    return [
+        "TTS_STEAL leaked into the compiled step (host-side scheduling "
+        "knob must be program-invisible)"
+    ]
