@@ -1271,6 +1271,75 @@ def _steal_ab_rows(extras: list) -> None:
         })
 
 
+def _bytes_ab_rows(extras: list) -> None:
+    """Narrow-node-storage A/B (problems/base.py TTS_NARROW — never fails
+    the bench): per arm (auto vs 0) on real ta014 shapes, the host bytes
+    per node and per prmu row from ``node_fields`` (the 80B -> 20B
+    headline), plus measured artifacts from a budgeted resident run —
+    checkpoint file size and the snapshot's host-transfer payload bytes —
+    and a complete CPU-sim search on a reduced instance whose counts gate
+    the row (``parity``): the encoding at rest must never change what the
+    search explores. On the CPU sim the wall delta is noise; the byte
+    columns are the evidence, the hardware session banks the bandwidth
+    effect (scripts/hw_session.sh NARROW_AB)."""
+    import tempfile
+
+    import numpy as np
+
+    from tpu_tree_search.engine import checkpoint as _ckpt
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import PFSPProblem
+    from tpu_tree_search.problems.pfsp import taillard
+
+    try:
+        row = {"metric": "bytes_ab", "inst": "ta014"}
+        ptm = taillard.reduced_instance(14, jobs=10, machines=5)
+        counts = {}
+        for arm, mode in (("narrow", "auto"), ("wide", "0")):
+            with _env_override("TTS_NARROW", mode):
+                prob = PFSPProblem(inst=14)
+                fields = prob.node_fields()
+                per_node = sum(
+                    int(np.prod(shape, dtype=np.int64))
+                    * np.dtype(dt).itemsize
+                    for shape, dt in fields.values()
+                )
+                row[f"{arm}_bytes_per_node"] = per_node
+                row[f"{arm}_prmu_bytes"] = (
+                    int(np.prod(fields["prmu"][0], dtype=np.int64))
+                    * fields["prmu"][1].itemsize
+                )
+                with tempfile.TemporaryDirectory() as td:
+                    path = os.path.join(td, "ab.ckpt")
+                    resident_search(prob, m=8, M=256, K=2, max_steps=2,
+                                    checkpoint_path=path)
+                    row[f"{arm}_ckpt_bytes"] = os.path.getsize(path)
+                    snap = _ckpt.load(path, prob)
+                    row[f"{arm}_snapshot_host_bytes"] = sum(
+                        np.asarray(v).nbytes for v in snap.batch.values()
+                    )
+                small = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+                resident_search(small, m=8, M=64, K=8)  # warm
+                t0 = time.perf_counter()
+                res = resident_search(small, m=8, M=64, K=8)
+                row[f"{arm}_sim_wall_s"] = round(time.perf_counter() - t0, 3)
+                counts[arm] = (res.explored_tree, res.explored_sol, res.best)
+        row["prmu_shrink"] = round(
+            row["wide_prmu_bytes"] / max(row["narrow_prmu_bytes"], 1), 2)
+        row["node_shrink"] = round(
+            row["wide_bytes_per_node"] / max(row["narrow_bytes_per_node"], 1),
+            2)
+        row["ckpt_shrink"] = round(
+            row["wide_ckpt_bytes"] / max(row["narrow_ckpt_bytes"], 1), 2)
+        row["parity"] = counts["narrow"] == counts["wide"]
+        extras.append(row)
+    except Exception as e:  # noqa: BLE001 — A/B rows never fail a bench
+        extras.append({
+            "metric": "bytes_ab",
+            "error": f"{type(e).__name__}: {e}",
+        })
+
+
 def _megakernel_ab_rows(extras: list, on_tpu: bool) -> None:
     """One-kernel-cycle A/B (ops/megakernel.py — the keep/retire evidence
     row, docs/HW_VALIDATION.md). Off-chip the row is a PARITY GATE only:
@@ -1669,6 +1738,10 @@ def _main(partial: BenchPartial) -> int:
         # simulated-latency harness, parity-gated on node counts
         # (CPU-sim, every backend — the TTS_STEAL evidence row).
         _steal_ab_rows(extras)
+        # Narrow-node-storage A/B: bytes/node, prmu row, checkpoint and
+        # snapshot payload sizes narrow-vs-wide on ta014, parity-gated on
+        # a reduced-instance search (the TTS_NARROW evidence row).
+        _bytes_ab_rows(extras)
     # Published-config rate rows run in BOTH modes (bounded — a few
     # dispatches each), so any green window banks a first ta021/N16/N17
     # number automatically.

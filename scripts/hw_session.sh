@@ -233,6 +233,51 @@ TTS_MEGAKERNEL=force timeout 900 python -m tpu_tree_search.cli profile pfsp \
     | tee PHASES_ta014_lb1_megakernel.json \
   || echo "TTS PROFILE (megakernel armed) FAILED"
 
+echo "== 8c/9 narrow node storage A/B (TTS_NARROW bandwidth evidence) =="
+# The ISSUE 15 decision row (docs/HW_VALIDATION.md keep/retire): ta014
+# lb1 at the headline config, wide vs narrow host pools, guard armed —
+# golden parity asserted inline, bytes + timed rows banked in
+# NARROW_AB.json. The byte columns are facts from the layout; the walls
+# are the HBM/PCIe bandwidth effect this session exists to measure.
+TTS_GUARD=1 timeout 900 python - <<'EOF' | tee NARROW_AB.json \
+  || echo "NARROW AB FAILED"
+import json, os, time
+import numpy as np
+from tpu_tree_search.engine import checkpoint as ckpt
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.problems import PFSPProblem
+
+GOLDEN = None
+row = {"metric": "narrow_ab_hw", "inst": "ta014", "m": 25, "M": 1024}
+for label, knob in (("wide", "0"), ("narrow", "auto")):
+    os.environ["TTS_NARROW"] = knob
+    prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+    fields = prob.node_fields()
+    row[f"{label}_bytes_per_node"] = sum(
+        int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+        for shape, dt in fields.values())
+    resident_search(prob, m=25, M=1024)
+    t0 = time.perf_counter()
+    res = resident_search(prob, m=25, M=1024)
+    wall = time.perf_counter() - t0
+    counts = (res.explored_tree, res.explored_sol, res.best)
+    if GOLDEN is None:
+        GOLDEN = counts
+    assert counts == GOLDEN, f"{label}: {counts} != {GOLDEN}"
+    path = f"/tmp/narrow_ab_{label}.ckpt"
+    resident_search(prob, m=25, M=1024, max_steps=2, checkpoint_path=path)
+    row[f"{label}_ckpt_bytes"] = os.path.getsize(path)
+    snap = ckpt.load(path, prob)
+    row[f"{label}_snapshot_host_bytes"] = sum(
+        np.asarray(v).nbytes for v in snap.batch.values())
+    row[f"{label}_s"] = round(wall, 3)
+    row[f"{label}_nodes_per_sec"] = round(res.explored_tree / wall, 1)
+row["speedup"] = round(row["wide_s"] / max(row["narrow_s"], 1e-9), 3)
+row["node_shrink"] = round(
+    row["wide_bytes_per_node"] / row["narrow_bytes_per_node"], 2)
+print(json.dumps(row))
+EOF
+
 echo "== 9/9 tile sweep (per-kernel compile/throughput; informational) =="
 # Full ta014 tables were measured in the round-5 session
 # (docs/HW_VALIDATION.md); re-run is cheap with a warm cache and catches

@@ -139,6 +139,63 @@ def test_checkpoint_accepts_v1_when_meta_matches(tmp_path):
         ckpt.load(apath, aprob)
 
 
+def test_committed_v1_fixture_resumes():
+    """The committed v1 fixture (tests/data/nqueens_n9_v1.ckpt.npz — a real
+    interrupted N=9 resident run rewritten to the v1 header, wide-int32
+    depth) must keep loading and resuming to the sequential goldens under
+    every future format bump: cross-version compatibility pinned by a file
+    on disk, not by a writer that evolves with the reader."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "data",
+                        "nqueens_n9_v1.ckpt.npz")
+    prob = NQueensProblem(N=9)
+    c = ckpt.load(path, prob)
+    assert c.tree == 734 and c.sol == 0
+    # Loader casts the v1 wide payload to the live storage dtypes.
+    fields = prob.node_fields()
+    for k, v in c.batch.items():
+        assert v.dtype == fields[k][1]
+    seq = sequential_search(prob)
+    done = resident_search(prob, m=8, M=64, K=2, resume_from=path)
+    assert done.complete
+    assert (done.explored_tree, done.explored_sol, done.best) == (
+        seq.explored_tree, seq.explored_sol, seq.best)
+
+
+@pytest.mark.parametrize("writer,reader", [("auto", "0"), ("0", "auto")])
+def test_cross_narrow_resume_bit_identical(tmp_path, monkeypatch,
+                                           writer, reader):
+    """A checkpoint written under one TTS_NARROW setting resumed under the
+    other must reproduce the uninterrupted sequential goldens exactly —
+    the npz is self-describing and the loader casts to the live dtypes,
+    so narrow<->wide files are interchangeable bit-for-bit."""
+    path = str(tmp_path / f"x{writer}{reader}.ckpt")
+    ptm = taillard.reduced_instance(14, jobs=8, machines=5)
+
+    def fresh():
+        return PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+
+    # Pin the incumbent so explored counts are order-independent (same
+    # discipline as the mesh resume test above).
+    opt = sequential_search(fresh()).best
+    seq = sequential_search(fresh(), initial_best=opt)
+    monkeypatch.setenv("TTS_NARROW", writer)
+    part = resident_search(fresh(), m=8, M=64, K=2, initial_best=opt,
+                           max_steps=2, checkpoint_path=path)
+    assert not part.complete
+    monkeypatch.setenv("TTS_NARROW", reader)
+    prob = fresh()
+    c = ckpt.load(path, prob)
+    fields = prob.node_fields()
+    for k, v in c.batch.items():
+        assert v.dtype == fields[k][1]
+    done = resident_search(prob, m=8, M=64, K=2, resume_from=path)
+    assert done.complete
+    assert (done.explored_tree, done.explored_sol, done.best) == (
+        seq.explored_tree, seq.explored_sol, opt)
+
+
 def test_resolve_capacity_grows_for_chunk_floor():
     """A tiny explicit capacity must grow to fit the 64-chunk floor rather
     than leave M*n > capacity/2, which would starve the device loop and
@@ -278,10 +335,11 @@ def test_dist_resume_refuses_mismatched_cuts(tmp_path):
     for h in (0, 1):
         with np.load(path + f".h{h}") as data:
             header = json.loads(bytes(data["header"]).decode())
-        # Multi-host per-host files write format v3 so pre-v3 readers (no
-        # hosts/cut checks) refuse them instead of resuming one host's
-        # share as the whole frontier (ADVICE r4).
-        assert header["version"] == 3
+        # Multi-host per-host files write the higher format version (v4
+        # since narrow storage) so pre-v3 readers (no hosts/cut checks)
+        # refuse them instead of resuming one host's share as the whole
+        # frontier (ADVICE r4).
+        assert header["version"] == ckpt.FORMAT_VERSION == 4
         assert header["hosts"] == 2
         tags.append(header["cut_tag"])
     # Lockstep cut: the SAME "<run-uuid>:<round>" tag on every host.

@@ -167,6 +167,18 @@ def test_all_tiers_match_sequential_megakernel_axis(seed, lb, monkeypatch):
     _fuzz_all_tiers(seed, lb)
 
 
+@pytest.mark.slow  # every tier recompiles per TTS_NARROW token; CI tests-narrow runs it unfiltered
+@pytest.mark.parametrize("mode", ["0", "auto"])
+def test_all_tiers_match_sequential_narrow_axis(mode, monkeypatch):
+    """Narrow-node-storage axis (problems/base.py TTS_NARROW): with host
+    pools/staging at int8/int16 storage dtypes (auto) and with everything
+    forced wide int32 (0), every tier must land the sequential counts —
+    widening happens only inside evaluator arithmetic, so the dtype of
+    the bytes at rest can never change what the search explores."""
+    monkeypatch.setenv("TTS_NARROW", mode)
+    _fuzz_all_tiers(193, "lb1")
+
+
 @pytest.mark.parametrize("mode", ["dense", "auto"])
 def test_all_tiers_match_sequential_compact_axis(mode, monkeypatch):
     """Compaction-path axis (survivor-path overhaul): every tier — the

@@ -430,6 +430,53 @@ def test_max_steps_budget_survives_preemption(tmp_path):
         d.close()
 
 
+def test_checkpoint_fetch_gzip_negotiated(tmp_path):
+    """``GET /job/<id>/checkpoint`` serves identity bytes to plain clients
+    and gzip to clients that ask (Accept-Encoding) — the `tts migrate`
+    transport. Both encodings must decode to the exact on-disk npz: a
+    migrated job's resume is bit-identity-critical, so the compression is
+    transport-only."""
+    import gzip
+
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / "state"))
+    d.start()
+    try:
+        base = d.url
+        # A cancelled-mid-run job is the migrate source state: the cut
+        # leaves a live checkpoint (done jobs delete theirs).
+        _, sub = _post(base, "/submit",
+                       {"problem": "nqueens", "N": 13, "M": 256, "K": 2,
+                        "max_steps": 1 << 20})
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            _, rec = _get(base, f"/job/{sub['id']}")
+            if rec["state"] == "running":
+                break
+            time.sleep(0.05)
+        assert rec["state"] == "running"
+        time.sleep(0.5)  # let a dispatch land so the cut has a frontier
+        code, _resp = _post(base, f"/job/{sub['id']}/cancel", {})
+        assert code == 200
+        rec = _wait_final(base, sub["id"])
+        assert rec["state"] == "cancelled" and rec["checkpoint"]
+        disk = open(rec["checkpoint"], "rb").read()
+        with urllib.request.urlopen(
+                base + f"/job/{sub['id']}/checkpoint", timeout=30) as r:
+            assert r.headers.get("Content-Encoding") is None
+            assert r.read() == disk
+        req = urllib.request.Request(
+            base + f"/job/{sub['id']}/checkpoint",
+            headers={"Accept-Encoding": "gzip"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers.get("Content-Encoding") == "gzip"
+            wire = r.read()
+            assert int(r.headers["Content-Length"]) == len(wire)
+        assert gzip.decompress(wire) == disk
+    finally:
+        d.scheduler.drain(timeout_s=30.0)
+        d.close()
+
+
 def test_cancel_max_steps_job_ends_cancelled(daemon):
     """A cancelled max_steps job must report 'cancelled' — its yield cut
     used to be indistinguishable from the max_steps cutoff, recording a

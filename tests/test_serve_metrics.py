@@ -278,6 +278,20 @@ def test_metrics_scrape_under_load_matches_registry(daemon):
     # compiled cold, the warm same-class admission compiled nothing.
     prog = parsed["tts_serve_new_programs_total"]
     assert sum(prog.values()) >= 2
+    # Device-resident pool bytes: every class ran (a resident program is
+    # cached), so its footprint gauge is positive and matches the pool's
+    # own accounting — the HBM number `tts top` renders per class.
+    from tpu_tree_search.serve.pool import resident_pool_bytes
+
+    pool_bytes = parsed["tts_serve_pool_bytes"]
+    assert {(("cls", c),) for c in classes} <= set(pool_bytes)
+    for entry in daemon.pool.stats():
+        assert pool_bytes[(("cls", entry["class"]),)] == entry["pool_bytes"]
+        assert entry["pool_bytes"] > 0
+    with daemon.pool._lock:
+        entries = list(daemon.pool._classes.values())
+    assert all(resident_pool_bytes(e.problem) == e.stats()["pool_bytes"]
+               for e in entries)
 
 
 # -- follow_job reconnect dedupe (the `tts watch --job` reprint bug) ---------

@@ -55,7 +55,7 @@ KNOBS = (
     "TTS_PIPELINE", "TTS_K", "TTS_GUARD", "TTS_PALLAS", "TTS_PALLAS_LB2",
     "TTS_LB2_STAGED", "TTS_XLA_TRACE", "TTS_FLIGHTREC", "TTS_COSTMODEL",
     "TTS_QUALITY", "TTS_MEGAKERNEL", "TTS_STEAL", "TTS_PODS",
-    "TTS_SIM_LAT_ICI", "TTS_SIM_LAT_DCN",
+    "TTS_SIM_LAT_ICI", "TTS_SIM_LAT_DCN", "TTS_NARROW",
 )
 
 #: Matrix axes (the lb2 families add the pair-block axis).
@@ -393,6 +393,7 @@ VARIANT_ENVS = {
     "mk0": {"TTS_MEGAKERNEL": "0"},
     "steal-flat": {"TTS_STEAL": "flat"},
     "steal-hier": {"TTS_STEAL": "hier", "TTS_PODS": "2"},
+    "narrow0": {"TTS_NARROW": "0"},
 }
 
 
@@ -475,6 +476,14 @@ def cache_key_artifact(family: str) -> CacheKeyArtifact:
         "TTS_MEGAKERNEL": (
             build({**base, "TTS_MEGAKERNEL": "0"}),
             build({**base, "TTS_MEGAKERNEL": "force"}),
+        ),
+        # Narrow host storage: the device step jaxpr is knob-inert
+        # (`narrow-knob-inert`), but the HOST staging avals the program
+        # was built against are not — a flip must rebuild so a stale
+        # program never receives the other layout's arrays.
+        "TTS_NARROW": (
+            build({**base, "TTS_NARROW": "auto"}),
+            build({**base, "TTS_NARROW": "0"}),
         ),
     }
     if family == "pfsp-lb2":

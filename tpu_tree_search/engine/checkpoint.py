@@ -25,12 +25,19 @@ import numpy as np
 from ..problems.base import NodeBatch, Problem
 
 # v2: PFSP meta carries a p_times digest (ptimes_sha).
-# v3: multi-host per-host files (hosts > 1). Single-host files keep writing
-# v2 so older readers still load them; multi-host files write v3 so a
-# pre-v3 reader — which has no hosts/cut coherence checks — refuses them
-# instead of silently resuming one host's share as the whole frontier.
-FORMAT_VERSION = 3
-_SINGLE_HOST_VERSION = 2
+# v3 (multi-host) / v2 (single-host): multi-host per-host files write the
+# higher version so a pre-v3 reader — which has no hosts/cut coherence
+# checks — refuses them instead of silently resuming one host's share as
+# the whole frontier.
+# v4 (multi-host) / v3 (single-host): narrow node storage (TTS_NARROW,
+# problems/base.py) — field arrays are saved at the problem's storage
+# dtypes (int8/int16), shrinking payloads ~4x. The npz is self-describing,
+# so the loader casts every field to the LIVE problem's node_fields dtypes
+# on the way in: old wide files resume under narrow runtimes, narrow files
+# resume under TTS_NARROW=0, bit-identically either way (node values are
+# range-proven for the narrow dtypes by construction).
+FORMAT_VERSION = 4
+_SINGLE_HOST_VERSION = 3
 
 
 class RunController:
@@ -194,7 +201,7 @@ def load(path: str, problem: Problem, expect_hosts: int = 1) -> Checkpoint:
     (or double-explore) the other hosts' shares — refuse loudly instead."""
     with np.load(path) as data:
         header = json.loads(bytes(data["header"]).decode())
-        if header["version"] not in (1, _SINGLE_HOST_VERSION, FORMAT_VERSION):
+        if header["version"] not in (1, 2, _SINGLE_HOST_VERSION, FORMAT_VERSION):
             raise ValueError(f"unsupported checkpoint version {header['version']}")
         want = problem_meta(problem)
         got = dict(header["meta"])
@@ -224,7 +231,16 @@ def load(path: str, problem: Problem, expect_hosts: int = 1) -> Checkpoint:
                 f"{expect_hosts} host(s) would lose or double-explore the "
                 "other shares (resume with the original host count)"
             )
-        batch = {k: data[f"field_{k}"] for k in header["fields"]}
+        # Cast every field to the LIVE problem's storage dtypes: the file
+        # may predate narrow storage (wide int32 payloads) or have been
+        # written under the opposite TTS_NARROW setting — the npz carries
+        # the dtypes, so the cast is exact in both directions.
+        fields = problem.node_fields()
+        batch = {
+            k: (np.asarray(data[f"field_{k}"]).astype(fields[k][1])
+                if k in fields else data[f"field_{k}"])
+            for k in header["fields"]
+        }
     return Checkpoint(
         meta=header["meta"], batch=batch,
         best=header["best"], tree=header["tree"], sol=header["sol"],

@@ -532,7 +532,9 @@ class _PFSPResident(_ResidentProgram):
     def derive_fields(self, batch: dict) -> dict:
         # depth == limit1 + 1 for every node the engine ever pushes (forward
         # branching; the root depth=0/limit1=-1 satisfies it too).
-        batch["depth"] = (batch["limit1"] + 1).astype(np.int32)
+        batch["depth"] = (batch["limit1"] + 1).astype(
+            self.problem.node_fields()["depth"][1]
+        )
         return batch
 
     def _swap_pos(self, aux_c):
@@ -1196,4 +1198,25 @@ def _contract_cache_key(art, cell):
         if a is not b:
             out.append(f"{knob} flip rebuilt the program (a host-only knob "
                        "leaks into the cache key and forks compilations)")
+    return out
+
+
+@contract(
+    "narrow-knob-inert",
+    claim="TTS_NARROW never changes the compiled resident step: the device "
+          "pools were always narrow (`_pool_int_dtype`) — the knob governs "
+          "HOST storage/transfer/checkpoint dtypes only, so the unset "
+          "(auto) build and the =0 build produce byte-identical step "
+          "jaxprs with identical carry widths",
+    artifact="variants",
+)
+def _contract_narrow_inert(art, cell):
+    if not art.has("off", "narrow0"):
+        return []
+    out = []
+    if art.text("off") != art.text("narrow0"):
+        out.append("TTS_NARROW=0 build differs from the unset (auto) build "
+                   "(narrow host storage leaked into the device program)")
+    if art.outvars("narrow0") != art.outvars("off"):
+        out.append("TTS_NARROW=0 build changed the carry width")
     return out

@@ -65,6 +65,25 @@ from . import pallas_kernels as PK
 #: overhead left to amortize (same window as the dense-compact policy).
 SMALL_M_LIMIT = 1 << 16
 
+#: the same window expressed in POOL BYTES (2^16 int32 elements): with
+#: narrow node storage armed (TTS_NARROW, problems/base.py) the write-back
+#: that bounds the small-M regime moves pool-dtype bytes, so the auto
+#: window widens by the narrowing factor — an int8 pool admits 4x the
+#: M*n product at the same byte traffic. TTS_NARROW=0 keeps the
+#: element-count window verbatim (`narrow-knob-inert`).
+SMALL_M_BYTES = SMALL_M_LIMIT * 4
+
+
+def _pool_itemsize(fam: str, n: int) -> int:
+    """Bytes per pool value element for the resident pool this cycle runs
+    against — the `engine/resident._pool_int_dtype` ladder (int8/int16/
+    int32 by n) for PFSP, the uint8 board for N-Queens. Mirrored here so
+    the kernel module keeps its lazy-import relationship with the engine
+    package."""
+    if fam == "nqueens":
+        return 1
+    return 1 if n <= 127 else (2 if n <= 32767 else 4)
+
 #: mirrors problems.base.INF_BOUND without importing the problems package
 #: into a kernel module (the packages import each other lazily).
 _INF_BOUND = 2**31 - 1
@@ -118,28 +137,36 @@ def _on_tpu(device) -> bool:
         return False
 
 
-def _mega_pool_bytes(M: int, n: int) -> int:
+def _mega_pool_bytes(M: int, n: int, pool_itemsize: int = 4) -> int:
     """The pool-resident VMEM charge of the fused cycle at chunk width M —
     the ``extra_bytes`` the feasibility gate adds on top of the bound
     kernels' own `_model_bytes` model.  Unlike the standalone kernels the
     batch tile here IS M (grid=(1,)), so these buffers cannot be tiled
     away: the child cube, the flattened (M*n, n) child rows plus the shift
     pass's live copies, the rank/dist columns, and the two triangular rank
-    operands are all live inside one grid step."""
+    operands are all live inside one grid step.  ``pool_itemsize`` charges
+    the pool-dtype tiles (the popped values entering and the compacted
+    rows leaving) at their storage width; the in-kernel intermediates stay
+    int32/f32 regardless."""
     r8, r128 = PK._r8, PK._r128
     Mn = M * n
     cube = M * r8(n) * r128(n) * 4          # (M, n, n) child cube
     flat = 3 * r8(Mn) * r128(n) * 4         # (Mn, n) rows + shift copies
     cols = 4 * r8(Mn) * 128 * 4             # aux/rank/dist/take columns
     tri = r8(M) * r128(M) * 4 + r8(n) * r128(n) * 4  # rank triangles
-    io = 3 * r8(M) * r128(n) * 4 + 128 * 4  # popped tile, keep, scalars
+    # popped pool tile + its narrow copy, keep plane, scalar lanes
+    io = (2 * r8(M) * r128(n) * pool_itemsize
+          + r8(M) * r128(n) * 4 + 128 * 4)
     return cube + flat + cols + tri + io
 
 
 def _fits(problem, fam: str, M: int, n: int) -> tuple[bool, str | None]:
     """VMEM feasibility at the fixed tile M (no `_auto_tile` shrinking —
     see `_mega_pool_bytes`)."""
-    extra = _mega_pool_bytes(M, n)
+    from ..problems.base import narrow_enabled
+
+    itemsize = _pool_itemsize(fam, n) if narrow_enabled() else 4
+    extra = _mega_pool_bytes(M, n, itemsize)
     if fam == "nqueens":
         need = PK._model_bytes(M, n, 1, extra, 3)
     elif fam == "lb1":
@@ -201,7 +228,18 @@ def resolve(problem, M: int, device=None, mp_axis: str | None = None,
         return Decision(True, False, interpret, None)
     if not _on_tpu(device) or PK.pallas_interpret():
         return Decision(False, True, False, "auto: not on a TPU backend")
-    if M * n > SMALL_M_LIMIT:
+    from ..problems.base import narrow_enabled
+
+    if narrow_enabled():
+        # Byte-based window: narrow pool storage moves fewer bytes per
+        # node, so the write-back-bound regime extends by the narrowing
+        # factor (4x at int8) at the same byte traffic.
+        win = M * n * _pool_itemsize(fam, n)
+        if win > SMALL_M_BYTES:
+            return Decision(False, True, False,
+                            f"auto: M*n pool bytes {win} above the small-M "
+                            f"window ({SMALL_M_BYTES} B)")
+    elif M * n > SMALL_M_LIMIT:
         return Decision(False, True, False,
                         f"auto: M*n={M * n} above the small-M window "
                         f"({SMALL_M_LIMIT})")

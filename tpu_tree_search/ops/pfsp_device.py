@@ -770,6 +770,7 @@ def routing_cache_token(problem, device=None) -> tuple:
     TTS_PALLAS_LB2 / TTS_LB2_STAGED between searches rebuilds instead of
     silently reusing a stale program. One definition — used by both the
     resident and mesh-resident cache keys."""
+    from ..problems.base import narrow_mode
     from . import pallas_kernels as PK
     from .megakernel import megakernel_mode
 
@@ -781,7 +782,11 @@ def routing_cache_token(problem, device=None) -> tuple:
                   # One-kernel cycle knob (ops/megakernel.py): the raw mode
                   # — the rest of the decision (M, device, family, mp) is
                   # already in every program cache key carrying this token.
-                  megakernel_mode())
+                  megakernel_mode(),
+                  # Narrow node storage (TTS_NARROW, problems/base.py):
+                  # host staging dtypes and the megakernel auto window are
+                  # trace-time decisions keyed on it.
+                  narrow_mode())
     if getattr(problem, "name", None) == "pfsp" and problem.lb == "lb2":
         tok += (
             _lb2_pallas_enabled(),
@@ -884,19 +889,32 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):
 
     Returns ``fn(parents: dict, count, best) -> (B, jobs) int32 bounds``;
     ``device`` selects the Pallas-vs-XLA path per target platform.
+
+    The offload tiers may stage ``prmu``/``limit1`` at the narrow storage
+    dtypes (TTS_NARROW, problems/base.py); bound arithmetic is exact at
+    int32, so every entry point widens first — a no-op cast when storage
+    is already wide (the resident tier pre-widens its popped chunks).
     """
+    def _wide(parents):
+        return (jnp.asarray(parents["prmu"]).astype(jnp.int32),
+                jnp.asarray(parents["limit1"]).astype(jnp.int32))
+
     if lb == "lb1":
         def evaluate(parents, count, best):
             del count, best
-            return lb1_bounds(parents["prmu"], parents["limit1"], tables, device)
+            prmu, limit1 = _wide(parents)
+            return lb1_bounds(prmu, limit1, tables, device)
     elif lb == "lb1_d":
         def evaluate(parents, count, best):
             del count, best
-            return lb1_d_bounds(parents["prmu"], parents["limit1"], tables, device)
+            prmu, limit1 = _wide(parents)
+            return lb1_d_bounds(prmu, limit1, tables, device)
     elif lb == "lb2":
         if lb2_staged_enabled(device, tables.ptm_t.shape[0]):
             @jax.jit
             def _staged(prmu, limit1, count, best):
+                prmu = prmu.astype(jnp.int32)
+                limit1 = limit1.astype(jnp.int32)
                 # Offload-path staging: children killed by the cheap lb1
                 # pass report their lb1 value (>= the dispatch-time best,
                 # so the host prunes them identically — lb2 >= lb1 and the
@@ -934,9 +952,8 @@ def make_evaluator(tables: PFSPDeviceTables, lb: str, device=None):
         else:
             def evaluate(parents, count, best):
                 del count, best
-                return lb2_bounds(
-                    parents["prmu"], parents["limit1"], tables, device
-                )
+                prmu, limit1 = _wide(parents)
+                return lb2_bounds(prmu, limit1, tables, device)
     else:
         raise ValueError(f"Unsupported lower bound: {lb!r}")
     return evaluate

@@ -380,8 +380,12 @@ class _MeshResidentProgram:
         # repeatedly (the dist_mesh donation rounds) would otherwise pay a
         # fresh XLA compile for every distinct frontier size.
         F = min(1 << (F - 1).bit_length(), self.capacity)
-        fr_v = np.zeros((D, F) + shape_v, dtype=np.int32)
-        fr_a = np.zeros((D, F), dtype=np.int32)
+        # Stage at the host storage dtypes (TTS_NARROW, problems/base.py):
+        # `_init` widens to the device pool dtypes on-chip, so the H2D
+        # upload ships narrow bytes.
+        fields = self.inner.problem.node_fields()
+        fr_v = np.zeros((D, F) + shape_v, dtype=fields[name_v][1])
+        fr_a = np.zeros((D, F), dtype=fields[name_a][1])
         for w, b in enumerate(shard_batches):
             k = counts[w]
             if k:

@@ -19,6 +19,7 @@ single node is the same dict with unbatched arrays.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Mapping
 
@@ -26,6 +27,26 @@ import numpy as np
 
 # A node batch: field name -> array whose leading axis is the batch.
 NodeBatch = dict[str, np.ndarray]
+
+
+def narrow_mode() -> str:
+    """``TTS_NARROW`` — narrow node storage dtypes (int8/int16 instead of
+    int32) through the host pools, staging, donate payloads, and
+    checkpoints. ``auto`` (default) narrows every field whose value range
+    provably fits; ``0`` pins the historical int32 layout (byte-identical
+    programs — the `narrow-knob-inert` contract). The device-resident
+    pools were already narrow (`engine/resident._pool_int_dtype`); this
+    knob closes the host side of the stack."""
+    mode = os.environ.get("TTS_NARROW", "auto")
+    if mode not in ("auto", "0"):
+        raise ValueError(
+            f"TTS_NARROW must be 'auto' or '0', got {mode!r}"
+        )
+    return mode
+
+
+def narrow_enabled() -> bool:
+    return narrow_mode() != "0"
 
 # Sentinel "no incumbent" upper bound (C uses INT_MAX, `pfsp_c.c`; Chapel
 # max(int)). Kept within int32 so device kernels can carry it.
@@ -49,9 +70,30 @@ class Problem:
     # parent i (SURVEY.md Appendix A "chunk cycle invariant").
     child_slots: int
 
-    def node_fields(self) -> Mapping[str, tuple[tuple[int, ...], np.dtype]]:
-        """Field name -> (per-node shape, dtype)."""
+    def field_specs(
+        self,
+    ) -> Mapping[str, tuple[tuple[int, ...], np.dtype, np.dtype]]:
+        """Field name -> (per-node shape, wide dtype, narrow storage dtype).
+
+        The narrow dtype is a problem-declared property: the problem knows
+        its fields' value ranges (a permutation of ``n`` jobs fits int8 for
+        n <= 127, int16 through the ta111-class n=500; depth/limit1 are
+        bounded by n). ``node_fields`` resolves the pair against the
+        ``TTS_NARROW`` knob — everything downstream (host pools, staging,
+        donate pickles, checkpoints) allocates from ``node_fields`` and
+        narrows automatically.
+        """
         raise NotImplementedError
+
+    def node_fields(self) -> Mapping[str, tuple[tuple[int, ...], np.dtype]]:
+        """Field name -> (per-node shape, storage dtype), with the
+        ``TTS_NARROW`` knob resolved. Single source of truth for every
+        host-side node buffer."""
+        narrow = narrow_enabled()
+        return {
+            name: (shape, np.dtype(nd if narrow else wd))
+            for name, (shape, wd, nd) in self.field_specs().items()
+        }
 
     def root(self) -> NodeBatch:
         """Batch of one: the root node."""

@@ -31,15 +31,19 @@ class NQueensProblem(Problem):
         self.g = int(g)
         self.child_slots = self.N
 
-    def node_fields(self):
+    def field_specs(self):
+        # board was already 1-byte; depth is bounded by N, so int16
+        # always fits (the device pool further narrows it to int8 when
+        # N <= 127 — `engine/resident._NQueensResident`).
         return {
-            "depth": ((), np.dtype(np.int32)),
-            "board": ((self.N,), np.dtype(np.uint8)),
+            "depth": ((), np.dtype(np.int32), np.dtype(np.int16)),
+            "board": ((self.N,), np.dtype(np.uint8), np.dtype(np.uint8)),
         }
 
     def root(self) -> NodeBatch:
+        depth_dt = self.node_fields()["depth"][1]
         return {
-            "depth": np.zeros((1,), dtype=np.int32),
+            "depth": np.zeros((1,), dtype=depth_dt),
             "board": np.arange(self.N, dtype=np.uint8)[None, :],
         }
 
@@ -70,7 +74,8 @@ class NQueensProblem(Problem):
                 child[depth], child[j] = child[j], child[depth]
                 kept.append(child)
         children = {
-            "depth": np.full(len(kept), depth + 1, dtype=np.int32),
+            "depth": np.full(len(kept), depth + 1,
+                             dtype=self.node_fields()["depth"][1]),
             "board": (
                 np.stack(kept) if kept else np.zeros((0, N), dtype=np.uint8)
             ),
@@ -114,9 +119,14 @@ class NQueensProblem(Problem):
 
         def evaluate(parents, count, best):
             """Batched safety labels, one slot per (parent, candidate column)
-            (`nqueens_gpu_chpl.chpl:97-123`)."""
+            (`nqueens_gpu_chpl.chpl:97-123`). Storage may stage depth
+            narrow (TTS_NARROW); the label math runs at int32 — a no-op
+            cast when storage is already wide."""
             del count, best
-            return core(parents["board"], parents["depth"])
+            import jax.numpy as jnp
+
+            depth = jnp.asarray(parents["depth"]).astype(jnp.int32)
+            return core(parents["board"], depth)
 
         return evaluate
 
@@ -146,7 +156,7 @@ class NQueensProblem(Problem):
         children_board[rows, di] = children_board[rows, kj]
         children_board[rows, kj] = tmp
         children = {
-            "depth": (depth[pi] + 1).astype(np.int32),
+            "depth": (depth[pi] + 1).astype(self.node_fields()["depth"][1]),
             "board": children_board,
         }
         return DecomposeResult(children, int(pi.size), sol_inc, best)

@@ -74,18 +74,23 @@ class PFSPProblem(Problem):
         self.lb1_data = B.make_lb1(p_times)
         self.lb2_data = B.make_lb2(self.lb1_data, lb2_variant)
 
-    def node_fields(self):
+    def field_specs(self):
+        # prmu holds job indices < jobs (int8 through 127 jobs, int16
+        # through the ta111-class n=500); depth/limit1 are bounded by
+        # jobs (limit1 >= -1), so int16 always fits.
+        prmu_narrow = np.int8 if self.jobs <= 127 else np.int16
         return {
-            "depth": ((), np.dtype(np.int32)),
-            "limit1": ((), np.dtype(np.int32)),
-            "prmu": ((self.jobs,), np.dtype(np.int32)),
+            "depth": ((), np.dtype(np.int32), np.dtype(np.int16)),
+            "limit1": ((), np.dtype(np.int32), np.dtype(np.int16)),
+            "prmu": ((self.jobs,), np.dtype(np.int32), np.dtype(prmu_narrow)),
         }
 
     def root(self) -> NodeBatch:
+        fields = self.node_fields()
         return {
-            "depth": np.zeros((1,), dtype=np.int32),
-            "limit1": np.full((1,), -1, dtype=np.int32),
-            "prmu": np.arange(self.jobs, dtype=np.int32)[None, :],
+            "depth": np.zeros((1,), dtype=fields["depth"][1]),
+            "limit1": np.full((1,), -1, dtype=fields["limit1"][1]),
+            "prmu": np.arange(self.jobs, dtype=fields["prmu"][1])[None, :],
         }
 
     # -- host path ---------------------------------------------------------
@@ -149,13 +154,15 @@ class PFSPProblem(Problem):
 
     def _children(self, kept_prmu: list, depth: int, limit1: int) -> NodeBatch:
         k = len(kept_prmu)
+        fields = self.node_fields()
+        prmu_dt = fields["prmu"][1]
         return {
-            "depth": np.full(k, depth + 1, dtype=np.int32),
-            "limit1": np.full(k, limit1 + 1, dtype=np.int32),
+            "depth": np.full(k, depth + 1, dtype=fields["depth"][1]),
+            "limit1": np.full(k, limit1 + 1, dtype=fields["limit1"][1]),
             "prmu": (
-                np.stack(kept_prmu).astype(np.int32)
+                np.stack(kept_prmu).astype(prmu_dt)
                 if kept_prmu
-                else np.zeros((0, self.jobs), dtype=np.int32)
+                else np.zeros((0, self.jobs), dtype=prmu_dt)
             ),
         }
 
@@ -240,9 +247,10 @@ class PFSPProblem(Problem):
         tmp = child_prmu[rows, di].copy()
         child_prmu[rows, di] = child_prmu[rows, kj]
         child_prmu[rows, kj] = tmp
+        fields = self.node_fields()
         children = {
-            "depth": (depth[pi] + 1).astype(np.int32),
-            "limit1": (limit1[pi] + 1).astype(np.int32),
-            "prmu": child_prmu.astype(np.int32),
+            "depth": (depth[pi] + 1).astype(fields["depth"][1]),
+            "limit1": (limit1[pi] + 1).astype(fields["limit1"][1]),
+            "prmu": child_prmu.astype(fields["prmu"][1]),
         }
         return DecomposeResult(children, int(pi.size), sol_inc, best)

@@ -267,6 +267,19 @@ class _Enough(Exception):
 # -- the `tts top` operator console ------------------------------------------
 
 
+def _fmt_bytes(n) -> str:
+    """Human bytes for the per-class pool column (0 -> '-': nothing
+    resident yet, e.g. the class is admitted but not compiled)."""
+    n = float(int(n or 0))
+    if n <= 0:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
 def _render_top(health: dict, jobs: list, classes: dict) -> str:
     """The ``tts top`` display: daemon header, per-class occupancy table,
     then per-job rows (active work first, newest terminal jobs last)."""
@@ -290,7 +303,7 @@ def _render_top(health: dict, jobs: list, classes: dict) -> str:
     if classes:
         lines.append("")
         lines.append(f"{'class':<44} {'warm':>4} {'progs':>5} "
-                     f"{'steps':>5} {'jobs':>5} {'slots':>5}")
+                     f"{'steps':>5} {'jobs':>5} {'slots':>5} {'pool':>8}")
         for st in sorted(classes, key=lambda st: st.get("class", "")):
             if "slots_occupied" in st:
                 slots = f"{st['slots_occupied']}/{st.get('batch_slots', '?')}"
@@ -302,7 +315,8 @@ def _render_top(health: dict, jobs: list, classes: dict) -> str:
                 f"{st.get('programs', 0):>5} "
                 f"{st.get('step_cache_entries', 0):>5} "
                 f"{st.get('jobs_admitted', 0):>5} "
-                f"{slots:>5}")
+                f"{slots:>5} "
+                f"{_fmt_bytes(st.get('pool_bytes', 0)):>8}")
     active = [j for j in jobs
               if j.get("state") in ("running", "queued", "requeued")]
     finished = [j for j in jobs if j not in active]
@@ -402,9 +416,18 @@ def migrate_main(jid: str, to_url: str, port: int = DEFAULT_PORT,
               file=sys.stderr)
         return 2
     try:
-        with urlopen(base + f"/job/{jid}/checkpoint",  # noqa: S310
-                     timeout=30.0) as resp:
+        # Ask for gzip transport: urllib neither advertises nor decodes
+        # it on its own, so both ends are explicit here. Old daemons
+        # ignore the header and send identity — both shapes are handled.
+        req = Request(base + f"/job/{jid}/checkpoint",
+                      headers={"Accept-Encoding": "gzip"})
+        with urlopen(req, timeout=30.0) as resp:  # noqa: S310
             raw = resp.read()
+            wire_bytes = len(raw)
+            if resp.headers.get("Content-Encoding") == "gzip":
+                import gzip
+
+                raw = gzip.decompress(raw)
     except (URLError, OSError) as e:
         print(f"Error: checkpoint fetch failed: {e}", file=sys.stderr)
         return 2
@@ -432,9 +455,14 @@ def migrate_main(jid: str, to_url: str, port: int = DEFAULT_PORT,
     if as_json:
         print(json.dumps({"from": jid, "id": sub["id"], "to": dst,
                           "class": sub.get("class"),
-                          "warm": sub.get("warm"), "steps_done": steps}))
+                          "warm": sub.get("warm"), "steps_done": steps,
+                          "ckpt_bytes": len(raw),
+                          "ckpt_wire_bytes": wire_bytes}))
     else:
         print(f"{jid} -> {sub['id']} @ {dst}  class={sub.get('class')}"
               f"{' (warm)' if sub.get('warm') else ''}"
-              f"  steps_done={steps}")
+              f"  steps_done={steps}"
+              f"  ckpt={len(raw)}B"
+              + (f" (gzip wire {wire_bytes}B)"
+                 if wire_bytes != len(raw) else ""))
     return 0

@@ -215,6 +215,10 @@ def render(daemon) -> str:
          "1 if the class program is warm (compiled), by shape class."),
         ("tts_serve_class_jobs_admitted", "jobs_admitted",
          "Jobs ever admitted, by shape class."),
+        ("tts_serve_pool_bytes", "pool_bytes",
+         "Device-resident pool bytes across the class's cached programs "
+         "(capacity x per-node pool bytes x slots/shards), read at "
+         "scrape time."),
     ):
         _gauge(lines, metric, help_,
                [((("cls", st.get("class", "?")),), int(st.get(field, 0)))

@@ -150,6 +150,34 @@ def compile_stats(problem) -> tuple[int, int]:
     return progs, steps
 
 
+def resident_pool_bytes(problem) -> int:
+    """Device-resident pool bytes across every program cached on a
+    problem instance — capacity x per-node pool bytes, times the slot
+    (B) / shard (D) count for the batched and mesh programs. Read at
+    scrape time for the `tts_serve_pool_bytes{cls}` gauge: the number
+    that shrinks when narrow node storage (TTS_NARROW) lands, and the
+    per-class HBM footprint an operator sizes co-tenancy against."""
+    import numpy as np
+
+    total = 0
+    for attr in ("_resident_programs", "_mesh_programs",
+                 "_batched_programs"):
+        cache = list((getattr(problem, attr, None) or {}).values())
+        for prog in cache:
+            inner = getattr(prog, "inner", prog)
+            fields = getattr(inner, "pool_fields", None)
+            cap = getattr(inner, "capacity", None)
+            if fields is None or cap is None:
+                continue
+            copies = int(getattr(prog, "B", 0) or getattr(prog, "D", 0) or 1)
+            per_node = sum(
+                int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+                for _name, dt, shape in fields
+            )
+            total += copies * int(cap) * per_node
+    return total
+
+
 class ClassEntry:
     """One shape class: the shared problem instance plus admission
     bookkeeping. ``warm`` flips after the first job of the class has
@@ -171,6 +199,7 @@ class ClassEntry:
             "warm": self.warm,
             "programs": progs,
             "step_cache_entries": steps,
+            "pool_bytes": resident_pool_bytes(self.problem),
         }
 
 

@@ -286,9 +286,21 @@ class _Handler(BaseHTTPRequestHandler):
                     else:
                         with open(path, "rb") as f:
                             body = f.read()
+                        # Checkpoint payloads are npz (already deflated),
+                        # but the header/meta rows and the base64 hop on
+                        # resubmit still shave real bytes under gzip —
+                        # negotiated, so plain curl keeps working.
+                        accept = self.headers.get("Accept-Encoding", "")
+                        gzipped = "gzip" in accept.lower()
+                        if gzipped:
+                            import gzip as _gzip
+
+                            body = _gzip.compress(body, compresslevel=6)
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "application/octet-stream")
+                        if gzipped:
+                            self.send_header("Content-Encoding", "gzip")
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
