@@ -1436,6 +1436,136 @@ def main() -> int:
         raise
 
 
+# -- fleet saturation (`python bench.py fleet_sat`) --------------------------
+
+FLEET_SAT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "FLEET_SAT.json")
+
+
+def fleet_sat_main() -> int:
+    """``python bench.py fleet_sat``: drive an in-process fleet (router +
+    N daemons) through the seeded loadgen rate ladder and bank the
+    saturation curve — offered jobs/s vs achieved jobs/s and p50/p99
+    queue-wait ms, overall and per shape class — as FLEET_SAT.json.
+
+    The curve is banked flush-as-you-go (one atomic rewrite per rate
+    point), so a wall-clock kill still leaves a usable prefix — the same
+    lesson BENCH_PARTIAL.json encodes. Knobs: TTS_FLEET_SAT_RATES
+    (comma list of offered jobs/s), TTS_FLEET_SAT_JOBS (jobs per rate),
+    TTS_FLEET_SAT_DAEMONS, TTS_FLEET_SAT_SEED, TTS_FLEET_SAT_OUT.
+    CPU-sim runs (JAX_PLATFORMS=cpu — the CI smoke) write to tempdir to
+    keep the working tree clean; hardware sessions keep the committed
+    path (scripts/hw_session.sh stage 9b)."""
+    partial = BenchPartial()
+    partial.install_sigterm()
+    import tempfile as _tempfile
+
+    from tpu_tree_search.cli import enable_compile_cache
+    from tpu_tree_search.fleet.loadgen import make_plan, saturation_curve
+    from tpu_tree_search.fleet.router import FleetRouter
+    from tpu_tree_search.serve.server import ServeDaemon
+
+    enable_compile_cache()
+    cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    out = os.environ.get("TTS_FLEET_SAT_OUT") or (
+        os.path.join(_tempfile.gettempdir(), "FLEET_SAT.json") if cpu
+        else FLEET_SAT_PATH)
+    rates = [float(x) for x in os.environ.get(
+        "TTS_FLEET_SAT_RATES", "0.5,1,2").split(",") if x.strip()]
+    jobs_per_rate = int(os.environ.get("TTS_FLEET_SAT_JOBS", "6"))
+    n_daemons = int(os.environ.get("TTS_FLEET_SAT_DAEMONS", "2"))
+    seed = int(os.environ.get("TTS_FLEET_SAT_SEED", "0"))
+    doc = {
+        "metric": "fleet_saturation_curve",
+        "daemons": n_daemons,
+        "jobs_per_rate": jobs_per_rate,
+        "seed": seed,
+        "commit": _git_head(),
+        "contracts": contracts_fingerprint(),
+        "platform": "cpu-sim" if cpu else "accelerator",
+        "status": "running",
+        "points": [],
+    }
+
+    def bank() -> None:
+        doc["updated"] = time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                                       time.gmtime())
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out)
+
+    bank()
+    state_root = _tempfile.mkdtemp(prefix="fleet_sat_")
+    partial.stage("fleet_up", "running", daemons=n_daemons)
+    daemons = [ServeDaemon(port=0,
+                           state_dir=os.path.join(state_root, f"d{i}"))
+               for i in range(n_daemons)]
+    for d in daemons:
+        d.start()
+    router = FleetRouter(port=0,
+                         state_dir=os.path.join(state_root, "fleet"),
+                         daemons=[d.url for d in daemons],
+                         scrape_interval_s=0.3, pull_interval_s=1.0)
+    router.start()
+    partial.stage("fleet_up", "ok", router=router.url,
+                  daemons=[d.url for d in daemons])
+    try:
+        # Pre-warm every class in the mix once (make_plan's own class
+        # set), so the curve measures queueing, not first-compile — the
+        # same reason the main bench warms before timing.
+        partial.stage("fleet_warm", "running")
+        warm_specs = {}
+        for row in make_plan(seed, 24, 100.0):
+            spec = {k: v for k, v in row["spec"].items()
+                    if k not in ("max_steps", "label")}
+            warm_specs.setdefault(json.dumps(spec, sort_keys=True), spec)
+        import urllib.request as _rq
+
+        for spec in warm_specs.values():
+            spec = dict(spec)
+            spec["max_steps"] = 8
+            req = _rq.Request(router.url + "/submit",
+                              data=json.dumps(spec).encode(),
+                              headers={"Content-Type": "application/json"})
+            with _rq.urlopen(req, timeout=600) as r:
+                json.loads(r.read().decode())
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            if all(j.brief()["state"] in ("done", "failed", "cancelled")
+                   for j in router.jobs.all()):
+                break
+            time.sleep(0.5)
+        partial.stage("fleet_warm", "ok", classes=len(warm_specs))
+
+        def on_point(row: dict) -> None:
+            doc["points"].append(row)
+            bank()
+            partial.stage(f"rate_{row['offered_jobs_per_s']:g}", "ok",
+                          achieved=row["achieved_jobs_per_s"],
+                          p99_ms=row["queue_wait_ms_p99"],
+                          done=row["done"])
+
+        saturation_curve(router.url, rates, seed=seed,
+                         jobs_per_rate=jobs_per_rate,
+                         steps_scale=12, steps_cap=80,
+                         timeout_s=600.0, on_point=on_point)
+        doc["status"] = "complete"
+        bank()
+        print(json.dumps({"metric": "fleet_saturation_curve",
+                          "points": len(doc["points"]),
+                          "artifact": out}))
+        partial.finish(0)
+        return 0
+    finally:
+        router.close()
+        for d in daemons:
+            d.scheduler.drain(timeout_s=30.0)
+            d.close()
+
+
 def _main(partial: BenchPartial) -> int:
     from tpu_tree_search.cli import enable_compile_cache
 
@@ -1948,4 +2078,6 @@ def _collect_extras(extras: list, on_tpu: bool, staged_ok: bool,
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet_sat":
+        sys.exit(fleet_sat_main())
     sys.exit(main())

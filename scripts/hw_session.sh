@@ -290,4 +290,13 @@ timeout 1500 python scripts/tile_sweep.py --inst 56 --kernels lb1,lb2 \
 timeout 1000 python scripts/tile_sweep.py --inst 111 --kernels lb1 \
   --tiles 8,16 --batch 512 || true
 
+echo "== 9b/9 fleet saturation curve (router over 2 daemons; FLEET_SAT.json) =="
+# The real-hardware run of the `bench.py fleet_sat` ladder: in-process
+# router + daemons on THIS host's accelerator, heavier offered rates and
+# bigger heavy-tailed budgets than the CI CPU smoke. Banked
+# flush-as-you-go to FLEET_SAT.json (one atomic rewrite per rate point),
+# so even a dead tunnel leaves a curve prefix. docs/SERVING.md "Fleet".
+timeout 2400 env TTS_FLEET_SAT_RATES=0.5,1,2,4,8 TTS_FLEET_SAT_JOBS=10 \
+  python bench.py fleet_sat || true
+
 echo "Done. Update docs/HW_VALIDATION.md with the results."

@@ -1,0 +1,475 @@
+"""Fleet router (tpu_tree_search/fleet/): class-aware placement, the
+lifecycle proxy, failure-driven recovery, and the seeded load generator.
+
+The placement policy is pure functions over synthetic daemon snapshots —
+those tests never open a socket. The end-to-end tests run real
+in-process daemons (port 0) behind an in-process router; only the
+SIGKILL-recovery test needs a subprocess daemon (you cannot SIGKILL a
+thread). Everything runs on the virtual CPU platform with small shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpu_tree_search.fleet import loadgen, placement
+from tpu_tree_search.fleet.placement import DaemonState
+from tpu_tree_search.fleet.router import FleetJobMap, FleetRouter
+from tpu_tree_search.serve.server import ServeDaemon
+
+_FINAL = ("done", "failed", "cancelled")
+
+#: The warm-placement shape shared across e2e tests (same reasoning as
+#: test_serve.NQ10: distinct shapes multiply CPU compiles).
+NQ10 = {"problem": "nqueens", "N": 10, "M": 256}
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _wait_final(router_url, fid, timeout_s=180.0):
+    """Poll the router until the fleet job is terminal AND fresh (a
+    cached record mid-recovery reports ``stale``)."""
+    deadline = time.monotonic() + timeout_s
+    rec = None
+    while time.monotonic() < deadline:
+        code, rec = _get(router_url, f"/job/{fid}")
+        assert code == 200, rec
+        if rec["state"] in _FINAL and not rec.get("stale"):
+            return rec
+        time.sleep(0.1)
+    raise AssertionError(f"fleet job {fid} not final in {timeout_s}s: {rec}")
+
+
+def _daemon(tmp_path, name, **kw):
+    d = ServeDaemon(port=0, state_dir=str(tmp_path / name), **kw)
+    d.start()
+    return d
+
+
+def _router(tmp_path, daemons, **kw):
+    kw.setdefault("scrape_interval_s", 0.2)
+    kw.setdefault("pull_interval_s", 0.3)
+    r = FleetRouter(port=0, state_dir=str(tmp_path / "fleet"),
+                    daemons=[d.url for d in daemons], **kw)
+    r.start()
+    return r
+
+
+# -- the pure placement policy (no sockets) ----------------------------------
+
+
+def _state(url, *, healthy=True, draining=False, queue_depth=0,
+           classes=(), jobs=(), wait_sum=0.0, wait_count=0):
+    st = DaemonState(url)
+    st.healthy = healthy
+    st.draining = draining
+    st.health = {"ok": healthy, "queue_depth": queue_depth}
+    st.classes = list(classes)
+    st.jobs = list(jobs)
+    st.metrics = {
+        "tts_serve_queue_wait_seconds_sum": {(): wait_sum},
+        "tts_serve_queue_wait_seconds_count": {(): wait_count},
+    }
+    return st
+
+
+def test_choose_prefers_warm_class():
+    warm = _state("http://a:1", queue_depth=3,
+                  classes=[{"class": "X", "warm": True}])
+    idle = _state("http://b:1", queue_depth=0)
+    st, reason = placement.choose([idle, warm], "X")
+    # Warm beats idle even though the warm daemon is busier: admission
+    # there costs queue time, admission elsewhere costs a compile.
+    assert st is warm and reason == "warm"
+
+
+def test_choose_warm_free_slot_beats_warm_busy():
+    busy = _state("http://a:1", classes=[
+        {"class": "X", "warm": True, "batch_slots": 2, "slots_occupied": 2}])
+    free = _state("http://b:1", classes=[
+        {"class": "X", "warm": True, "batch_slots": 2, "slots_occupied": 1}])
+    st, reason = placement.choose([busy, free], "X")
+    assert st is free and reason == "warm"
+
+
+def test_choose_cold_goes_least_loaded():
+    hot = _state("http://a:1", queue_depth=4)
+    cool = _state("http://b:1", queue_depth=1)
+    waity = _state("http://c:1", queue_depth=1, wait_sum=40.0, wait_count=4)
+    st, reason = placement.choose([hot, cool, waity], "Y")
+    # Same queue depth on b and c, but c's measured mean queue wait
+    # (10 s) adds 50 points — the cold job warms on b.
+    assert st is cool and reason == "cold"
+
+
+def test_choose_skips_unhealthy_and_draining():
+    dead = _state("http://a:1", healthy=False,
+                  classes=[{"class": "X", "warm": True}])
+    drain = _state("http://b:1", draining=True,
+                   classes=[{"class": "X", "warm": True}])
+    up = _state("http://c:1")
+    st, reason = placement.choose([dead, drain, up], "X")
+    assert st is up and reason == "cold"
+    st, why = placement.choose([dead, drain], "X")
+    assert st is None and "no healthy daemon" in why
+
+
+def test_pick_rebalance_hot_to_idle():
+    hot = _state("http://a:1", queue_depth=3, jobs=[
+        {"id": "job-1", "state": "running", "checkpoint": "x", "steps": 50},
+        {"id": "job-2", "state": "running", "checkpoint": "y", "steps": 90},
+        {"id": "job-3", "state": "running", "checkpoint": None, "steps": 99},
+    ])
+    idle = _state("http://b:1", queue_depth=0)
+    got = placement.pick_rebalance([hot, idle], min_depth=2)
+    assert got is not None
+    src, job, dst = got
+    # The longest-running CHECKPOINTED job moves (job-3 has more steps
+    # but no cut to carry).
+    assert src is hot and dst is idle and job["id"] == "job-2"
+    # Below the depth threshold, or with the idle daemon busy: no move.
+    hot.health["queue_depth"] = 1
+    assert placement.pick_rebalance([hot, idle], min_depth=2) is None
+    hot.health["queue_depth"] = 3
+    idle.jobs = [{"id": "j", "state": "running"}]
+    assert placement.pick_rebalance([hot, idle], min_depth=2) is None
+
+
+# -- the load generator (pure) -----------------------------------------------
+
+
+def test_make_plan_deterministic_and_heavy_tailed():
+    p1 = loadgen.make_plan(seed=42, n_jobs=200, rate_per_s=10.0)
+    p2 = loadgen.make_plan(seed=42, n_jobs=200, rate_per_s=10.0)
+    assert p1 == p2, "same seed must yield the identical plan"
+    p3 = loadgen.make_plan(seed=43, n_jobs=200, rate_per_s=10.0)
+    assert p1 != p3
+    ats = [row["at_s"] for row in p1]
+    assert ats == sorted(ats) and len(ats) == 200
+    steps = [row["spec"]["max_steps"] for row in p1]
+    assert all(8 <= s <= 600 for s in steps)
+    # Heavy tail: the cap actually binds somewhere in 200 draws, and the
+    # median sits far below the max (Pareto alpha=1.5).
+    assert max(steps) > 10 * sorted(steps)[len(steps) // 2]
+    classes = {loadgen._class_of(row["spec"]) for row in p1}
+    assert len(classes) == len(loadgen.DEFAULT_CLASSES)
+
+
+def test_quantile_nearest_rank():
+    assert loadgen._quantile([], 0.99) == 0.0
+    assert loadgen._quantile([5.0], 0.99) == 5.0
+    xs = list(range(100))
+    assert loadgen._quantile(xs, 0.50) == 50
+    assert loadgen._quantile(xs, 0.99) == 98
+
+
+# -- the host-only pin -------------------------------------------------------
+
+
+def test_router_is_host_only(monkeypatch):
+    """TTS_ROUTER must never fork a compiled-program cache key, and the
+    fleet package must never import jax — the router places work, it
+    does not compute."""
+    from tpu_tree_search.serve.pool import server_env_token
+
+    monkeypatch.delenv("TTS_ROUTER", raising=False)
+    t0 = server_env_token()
+    monkeypatch.setenv("TTS_ROUTER", "http://127.0.0.1:9999")
+    assert server_env_token() == t0, \
+        "TTS_ROUTER leaked into the server env token (a cache-key fork)"
+    import tpu_tree_search.fleet as fleet_pkg
+
+    pkg_dir = os.path.dirname(fleet_pkg.__file__)
+    for name in sorted(os.listdir(pkg_dir)):
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(pkg_dir, name)) as f:
+            src = f.read()
+        assert not re.search(r"^\s*(import jax|from jax)", src, re.M), \
+            f"fleet/{name} imports jax — the router must stay host-only"
+
+
+def test_fleet_job_map_durable(tmp_path):
+    m1 = FleetJobMap(str(tmp_path))
+    job = m1.create({"problem": "nqueens"}, "clsX")
+    m1.update(job, daemon="http://a:1", daemon_job="job-000007",
+              ckpt_steps=12)
+    m2 = FleetJobMap(str(tmp_path))
+    assert m2.load() == 1
+    back = m2.get(job.id)
+    assert back.daemon == "http://a:1" and back.daemon_job == "job-000007"
+    assert back.ckpt_steps == 12 and back.cls == "clsX"
+    # The sequence resumes past reloaded ids — no id reuse after restart.
+    assert int(m2.create({}, "c").id.split("-")[-1]) > \
+        int(job.id.split("-")[-1])
+
+
+# -- end-to-end: placement, proxy, streams -----------------------------------
+
+
+def test_fleet_warm_placement_zero_recompiles(tmp_path, monkeypatch):
+    """The acceptance E2E: three mixed-class jobs through a two-daemon
+    fleet. The second same-class job must land on the warm daemon and
+    admit with zero recompiles (TTS_GUARD=1 makes any hidden compile
+    fatal); the different-class job must spill to the other daemon."""
+    monkeypatch.setenv("TTS_GUARD", "1")
+    da = _daemon(tmp_path, "a")
+    db = _daemon(tmp_path, "b")
+    r = _router(tmp_path, [da, db])
+    try:
+        code, p1 = _post(r.url, "/submit", {**NQ10, "max_steps": 40})
+        assert code == 201 and p1["placement"] == "cold", p1
+        rec1 = _wait_final(r.url, p1["id"])
+        assert rec1["state"] == "done"
+        time.sleep(0.8)  # one keeper scrape refreshes /classes
+        code, p2 = _post(r.url, "/submit", {**NQ10, "max_steps": 40})
+        assert code == 201 and p2["placement"] == "warm", p2
+        assert p2["daemon"] == p1["daemon"], "warm job missed its daemon"
+        code, p3 = _post(r.url, "/submit",
+                         {"problem": "nqueens", "N": 9, "M": 256,
+                          "max_steps": 40})
+        assert code == 201 and p3["placement"] == "cold", p3
+        assert p3["daemon"] != p1["daemon"], \
+            "cold class should warm on the less-loaded daemon"
+        rec2 = _wait_final(r.url, p2["id"])
+        rec3 = _wait_final(r.url, p3["id"])
+        assert rec2["state"] == "done" and rec3["state"] == "done"
+        assert rec2["new_programs"] == 0 and \
+            rec2["new_step_compiles"] == 0, \
+            f"warm-placed job recompiled: {rec2}"
+        # Fleet-id rewrite: the proxied record answers with the fleet
+        # identity, the daemon-local id rides along.
+        assert rec2["id"] == p2["id"] and rec2["daemon_job"].startswith("job-")
+        code, fleet = _get(r.url, "/fleet")
+        assert fleet["router"]["daemons_healthy"] == 2
+        assert {j["state"] for j in fleet["jobs"]} == {"done"}
+    finally:
+        r.close()
+        for d in (da, db):
+            d.scheduler.drain(timeout_s=30.0)
+            d.close()
+
+
+def test_fleet_sse_stream_proxy(tmp_path):
+    """The proxied per-job stream ends with a ``done`` frame whose
+    payload carries the FLEET identity (that's the frame clients act
+    on), relayed from the owning daemon."""
+    da = _daemon(tmp_path, "a")
+    r = _router(tmp_path, [da])
+    try:
+        code, p = _post(r.url, "/submit", {**NQ10, "max_steps": 40})
+        assert code == 201, p
+        fid = p["id"]
+        done = None
+        with urllib.request.urlopen(r.url + f"/job/{fid}/stream",
+                                    timeout=120) as resp:
+            event = None
+            for raw in resp:
+                line = raw.decode().rstrip("\n")
+                if line.startswith("event: "):
+                    event = line[len("event: "):]
+                elif line.startswith("data: ") and event == "done":
+                    done = json.loads(line[len("data: "):])
+                    break
+        assert done is not None, "stream closed without a done frame"
+        assert done["id"] == fid and done["state"] == "done"
+        assert done["daemon_job"].startswith("job-")
+        assert done["daemon"] == da.url
+    finally:
+        r.close()
+        da.scheduler.drain(timeout_s=30.0)
+        da.close()
+
+
+def test_fleet_top_once_json(tmp_path, capsys):
+    """`tts top --router URL --once --json` emits the /fleet aggregate
+    as one JSON line (the CI smoke mode)."""
+    from tpu_tree_search.serve.client import fleet_top_main
+
+    da = _daemon(tmp_path, "a")
+    r = _router(tmp_path, [da])
+    try:
+        rc = fleet_top_main(r.url, once=True, as_json=True)
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out.strip())
+        assert payload["router"]["daemons"] == 1
+        assert payload["daemons"][0]["url"] == da.url
+        assert payload["daemons"][0]["healthy"] is True
+    finally:
+        r.close()
+        da.scheduler.drain(timeout_s=30.0)
+        da.close()
+
+
+def test_fleet_rejects_bad_spec_and_no_capacity(tmp_path):
+    da = _daemon(tmp_path, "a")
+    r = _router(tmp_path, [da])
+    try:
+        code, resp = _post(r.url, "/submit", {"problem": "tsp"})
+        assert code == 400 and "error" in resp
+        code, resp = _get(r.url, "/job/fjob-999999")
+        assert code == 404
+    finally:
+        r.close()
+        da.scheduler.drain(timeout_s=30.0)
+        da.close()
+    # With its only daemon gone (scrapes fail), placement must 503, not
+    # hang or 500 — the error names the reason.
+    r2 = FleetRouter(port=0, state_dir=str(tmp_path / "fleet2"),
+                     daemons=[da.url], scrape_interval_s=0.2)
+    r2.start()
+    try:
+        code, resp = _post(r2.url, "/submit", dict(NQ10))
+        assert code == 503 and "no daemon" in resp["error"]
+    finally:
+        r2.close()
+
+
+# -- end-to-end: failure-driven recovery -------------------------------------
+
+
+def test_sigkill_recovery_bit_identical(tmp_path):
+    """The headline guarantee: SIGKILL a daemon mid-job; the router
+    resubmits the last pulled checkpoint cut (with the remaining
+    ``max_steps`` budget) to a daemon registered afterwards, and the
+    final counters equal a standalone uninterrupted run's, exactly."""
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    ref = resident_search(NQueensProblem(N=12), m=25, M=256, K=4)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("TTS_GUARD", None)  # the subprocess compiles cold by design
+    pa = subprocess.Popen(
+        [sys.executable, "-m", "tpu_tree_search.cli", "serve", "--port",
+         "0", "--state-dir", str(tmp_path / "a"), "--ckpt-every", "0.3"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    db = None
+    r = None
+    try:
+        url_a = None
+        for line in pa.stdout:
+            m = re.search(r"(http://127\.0\.0\.1:\d+)", line)
+            if m:
+                url_a = m.group(1)
+                break
+        assert url_a, "daemon A never printed its banner"
+        r = _router(tmp_path, [], max_misses=2)
+        r.register(url_a)
+        code, p = _post(r.url, "/submit",
+                        {"problem": "nqueens", "N": 12, "M": 256, "K": 4})
+        assert code == 201, p
+        fid = p["id"]
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            fj = r.jobs.get(fid)
+            if fj.ckpt and fj.ckpt_steps > 0:
+                break
+            time.sleep(0.1)
+        assert fj.ckpt, "router never pulled a checkpoint cut"
+        pa.send_signal(signal.SIGKILL)
+        db = _daemon(tmp_path, "b")
+        time.sleep(0.8)  # let the death detector flag A's jobs first
+        r.register(db.url)
+        rec = _wait_final(r.url, fid)
+        assert rec["state"] == "done" and rec["daemon"] == db.url
+        assert rec["resubmits"] >= 1
+        res = rec["result"]
+        assert res["explored_tree"] == ref.explored_tree
+        assert res["explored_sol"] == ref.explored_sol
+        assert res["best"] == ref.best
+    finally:
+        if r is not None:
+            r.close()
+        if db is not None:
+            db.scheduler.drain(timeout_s=30.0)
+            db.close()
+        pa.kill()
+        pa.wait(timeout=30)
+
+
+def test_drain_triggers_live_migration(tmp_path):
+    """A draining daemon's ``/healthz`` flags it; the keeper migrates
+    its jobs to a healthy daemon over the live (cancel-with-cut) path
+    and the result still matches an uninterrupted run."""
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    ref = resident_search(NQueensProblem(N=11), m=25, M=256, K=4)
+    da = _daemon(tmp_path, "a", ckpt_every_s=0.3)
+    db = _daemon(tmp_path, "b")
+    r = _router(tmp_path, [da, db])
+    try:
+        code, p = _post(r.url, "/submit",
+                        {"problem": "nqueens", "N": 11, "M": 256, "K": 4})
+        assert code == 201, p
+        # Wait for the first slice to actually start on A, then drain A.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            code, rec = _get(r.url, f"/job/{p['id']}")
+            if rec.get("state") == "running":
+                break
+            time.sleep(0.1)
+        da.scheduler.drain(timeout_s=0.0)
+        rec = _wait_final(r.url, p["id"])
+        assert rec["state"] == "done" and rec["daemon"] == db.url
+        res = rec["result"]
+        assert res["explored_tree"] == ref.explored_tree
+        assert res["explored_sol"] == ref.explored_sol
+        assert res["best"] == ref.best
+    finally:
+        r.close()
+        for d in (da, db):
+            d.scheduler.drain(timeout_s=30.0)
+            d.close()
+
+
+@pytest.mark.slow
+def test_loadgen_saturation_point(tmp_path):
+    """One saturation point end-to-end: the loadgen drives a 2-daemon
+    fleet and every admitted job finishes with a measured queue wait.
+    (The full ladder is bench.py fleet_sat; this pins the plumbing.)"""
+    da = _daemon(tmp_path, "a")
+    db = _daemon(tmp_path, "b")
+    r = _router(tmp_path, [da, db])
+    try:
+        plan = loadgen.make_plan(seed=5, n_jobs=6, rate_per_s=2.0,
+                                 steps_scale=10, steps_cap=40)
+        res = loadgen.run_plan(r.url, plan, timeout_s=300.0)
+        s = res["summary"]
+        assert s["offered"] == 6 and s["admitted"] == 6, s
+        assert s["done"] == 6, (s, res["jobs"])
+        assert s["queue_wait_ms_p99"] >= s["queue_wait_ms_p50"] >= 0
+        assert res["per_class"], "per-class breakdown missing"
+    finally:
+        r.close()
+        for d in (da, db):
+            d.scheduler.drain(timeout_s=30.0)
+            d.close()

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -303,6 +304,52 @@ def build_parser() -> argparse.ArgumentParser:
                      "splicing/retiring jobs at dispatch boundaries with "
                      "zero recompiles (default TTS_BATCH_SLOTS or 1 = "
                      "today's serial path; docs/SERVING.md)")
+    srv.add_argument("--ckpt-every", type=float, default=None, metavar="S",
+                     help="cut a recoverable checkpoint every S seconds "
+                     "even with nothing waiting (default TTS_CKPT_EVERY "
+                     "or off) — the fleet router pulls these to survive "
+                     "a killed daemon (docs/SERVING.md)")
+    srv.add_argument("--router", type=str, default=None, metavar="URL",
+                     help="self-register with a `tts fleet` router at "
+                     "startup (default TTS_ROUTER; failure is non-fatal)")
+
+    from .fleet import DEFAULT_ROUTER_PORT as _ROUTER_PORT
+
+    flt = sub.add_parser(
+        "fleet",
+        help="class-aware router over N serve daemons: one URL places "
+        "each job where its compiled program is already warm, proxies "
+        "the job lifecycle, and recovers in-flight jobs off dead or "
+        "draining daemons via checkpoint resubmission (docs/SERVING.md)",
+    )
+    flt.add_argument("--port", type=int, default=_ROUTER_PORT,
+                     help=f"router port on 127.0.0.1 (default "
+                     f"{_ROUTER_PORT}; 0 = OS-assigned, printed at "
+                     "startup)")
+    flt.add_argument("--host", type=str, default="127.0.0.1")
+    flt.add_argument("--state-dir", type=str, default=None,
+                     help="durable fleet job map + pulled checkpoints "
+                     "(default TTS_FLEET_STATE or "
+                     "~/.cache/tpu_tree_search/fleet)")
+    flt.add_argument("--daemon", action="append", default=None,
+                     metavar="URL", dest="daemons",
+                     help="register a serve daemon (repeatable; daemons "
+                     "can also self-register via `tts serve --router` "
+                     "or POST /register)")
+    flt.add_argument("--scrape-interval", type=float, default=1.0,
+                     help="seconds between keeper scrapes of each "
+                     "daemon's /healthz,/classes,/metrics,/jobs")
+    flt.add_argument("--health-misses", type=int, default=3,
+                     help="consecutive failed probes before a daemon is "
+                     "declared dead and its jobs recovered (default 3)")
+    flt.add_argument("--pull-interval", type=float, default=2.0,
+                     help="seconds between checkpoint pulls of in-flight "
+                     "jobs (the SIGKILL-recovery fuel; default 2)")
+    flt.add_argument("--no-rebalance", action="store_true",
+                     help="disable hot->idle migration of long-runners")
+    flt.add_argument("--rebalance-depth", type=int, default=2,
+                     help="queue depth on the hot daemon before a "
+                     "rebalance move is considered (default 2)")
 
     smt = sub.add_parser(
         "submit",
@@ -313,6 +360,11 @@ def build_parser() -> argparse.ArgumentParser:
     smt.add_argument("--port", type=int, default=_SERVE_PORT,
                      help=f"serve daemon port (default {_SERVE_PORT})")
     smt.add_argument("--host", type=str, default="127.0.0.1")
+    smt.add_argument("--router", type=str, default=None, metavar="URL",
+                     help="submit through a `tts fleet` router instead "
+                     "of one daemon (default TTS_ROUTER): the job lands "
+                     "on the daemon whose compiled programs are already "
+                     "warm for its shape class")
     smt.add_argument("--wait", action="store_true",
                      help="follow the job's stream and print the final "
                      "result (exit 1 unless it completes)")
@@ -331,6 +383,10 @@ def build_parser() -> argparse.ArgumentParser:
     top.add_argument("--port", type=int, default=_SERVE_PORT,
                      help=f"serve daemon port (default {_SERVE_PORT})")
     top.add_argument("--host", type=str, default="127.0.0.1")
+    top.add_argument("--router", type=str, default=None, metavar="URL",
+                     help="aggregate a whole fleet instead of one daemon "
+                     "(default TTS_ROUTER): per-daemon rows + fleet "
+                     "totals from the router's /fleet endpoint")
     top.add_argument("--interval", type=float, default=2.0,
                      help="refresh period in seconds (default 2)")
     top.add_argument("--once", action="store_true",
@@ -1015,7 +1071,8 @@ def main(argv=None) -> int:
             )
         args = parser.parse_args(rest)
         if args.problem in ("lint", "check", "report", "watch", "profile",
-                            "serve", "submit", "warmup", "top", "migrate"):
+                            "serve", "submit", "warmup", "top", "migrate",
+                            "fleet"):
             parser.error("profile wraps a search run, not another "
                          "subcommand")
         args.phase_profile = True
@@ -1051,7 +1108,13 @@ def main(argv=None) -> int:
                           interval=args.interval, once=args.once,
                           as_json=args.watch_json)
     if args.problem == "top":
-        # Pure HTTP client of a serve daemon: no jax import.
+        # Pure HTTP client of a serve daemon (or fleet router): no jax.
+        router = args.router or os.environ.get("TTS_ROUTER")
+        if router:
+            from .serve.client import fleet_top_main
+
+            return fleet_top_main(router, interval=args.interval,
+                                  once=args.once, as_json=args.top_json)
         from .serve.client import top_main
 
         return top_main(port=args.port, host=args.host,
@@ -1072,7 +1135,22 @@ def main(argv=None) -> int:
         return serve_main(port=args.port, host=args.host,
                           state_dir=args.state_dir, workers=args.workers,
                           quantum_s=args.quantum, max_queue=args.max_queue,
-                          warm=args.warm, batch_slots=args.batch_slots)
+                          warm=args.warm, batch_slots=args.batch_slots,
+                          ckpt_every_s=args.ckpt_every,
+                          router=args.router or os.environ.get("TTS_ROUTER"))
+    if args.problem == "fleet":
+        # The router: host-only by construction (no jax anywhere in
+        # fleet/ — placement reuses the daemons' own host-side class-key
+        # computation), so no compile cache and no backend init.
+        from .fleet.router import router_main
+
+        return router_main(port=args.port, host=args.host,
+                           state_dir=args.state_dir, daemons=args.daemons,
+                           scrape_interval_s=args.scrape_interval,
+                           max_misses=args.health_misses,
+                           pull_interval_s=args.pull_interval,
+                           rebalance=not args.no_rebalance,
+                           rebalance_min_depth=args.rebalance_depth)
     if args.problem == "submit":
         # Thin client: re-parse the run command through THIS parser so
         # every CLI-side validation runs before the spec leaves the
@@ -1092,7 +1170,8 @@ def main(argv=None) -> int:
 
         return submit_main(spec_from_args(run_args), port=args.port,
                            host=args.host, wait=args.wait,
-                           as_json=args.submit_json)
+                           as_json=args.submit_json,
+                           router=args.router or os.environ.get("TTS_ROUTER"))
     if args.problem == "warmup":
         # Subprocess orchestration: each config compiles in its own
         # process against the persistent cache; no jax import here.

@@ -39,6 +39,6 @@ DEFAULT_PORT = 8643  # one above obs/live's default watch port
 #: (``tts_serve_build_info``) so fleet tooling can tell which daemons
 #: still need a rolling restart. Bump when the HTTP API or job-record
 #: schema changes.
-VERSION = "0.12.0"
+VERSION = "0.13.0"
 
 __all__ = ["DEFAULT_PORT", "VERSION"]

@@ -328,6 +328,12 @@ class BatchExecutor:
             self._cut(i, ctx, best, "cancelled")
         elif sched._stop_requested():
             self._cut(i, ctx, best, "requeued")
+        elif (sched.ckpt_every_s is not None
+              and time.monotonic() - sl.t0 >= sched.ckpt_every_s):
+            # Periodic recoverability cut (--ckpt-every): same preemption
+            # path as a quantum cut, so the slot's checkpoint + exact step
+            # count land on disk for the fleet router to pull.
+            self._cut(i, ctx, best, "preempted")
         elif (time.monotonic() - sl.t0 >= sched.quantum_s
               and sched._waiters()):
             self._cut(i, ctx, best, "preempted")

@@ -24,13 +24,39 @@ from . import DEFAULT_PORT
 _FINAL = ("done", "failed", "cancelled")
 
 
-def _post(url: str, payload: dict, timeout: float = 10.0) -> tuple[int, dict]:
+def _retrying(do, retry_s: float):
+    """Run ``do()`` retrying transient transport failures (connection
+    refused/reset during a daemon restart, socket timeouts) with
+    exponential backoff until the ``retry_s`` deadline, then re-raise.
+    An ``HTTPError`` is never retried here — a status line IS an answer;
+    callers branch on the code. ``retry_s=0`` keeps the old single-shot
+    behaviour."""
+    deadline = time.monotonic() + max(0.0, retry_s)
+    delay = 0.1
+    while True:
+        try:
+            return do()
+        except HTTPError:
+            raise
+        except (URLError, OSError, ConnectionError):
+            if time.monotonic() + delay > deadline:
+                raise
+            time.sleep(delay)
+            delay = min(2.0, delay * 2)
+
+
+def _post(url: str, payload: dict, timeout: float = 10.0,
+          retry_s: float = 0.0) -> tuple[int, dict]:
     body = json.dumps(payload).encode()
     req = Request(url, data=body,
                   headers={"Content-Type": "application/json"})
-    try:
+
+    def do():
         with urlopen(req, timeout=timeout) as resp:  # noqa: S310 — localhost
             return resp.status, json.loads(resp.read().decode())
+
+    try:
+        return _retrying(do, retry_s)
     except HTTPError as e:
         try:
             return e.code, json.loads(e.read().decode())
@@ -38,15 +64,44 @@ def _post(url: str, payload: dict, timeout: float = 10.0) -> tuple[int, dict]:
             return e.code, {"error": str(e)}
 
 
-def _get(url: str, timeout: float = 10.0) -> tuple[int, dict]:
-    try:
+def _get(url: str, timeout: float = 10.0,
+         retry_s: float = 0.0) -> tuple[int, dict]:
+    def do():
         with urlopen(url, timeout=timeout) as resp:  # noqa: S310
             return resp.status, json.loads(resp.read().decode())
+
+    try:
+        return _retrying(do, retry_s)
     except HTTPError as e:
         try:
             return e.code, json.loads(e.read().decode())
         except ValueError:
             return e.code, {"error": str(e)}
+
+
+def fetch_checkpoint(base: str, jid: str, timeout: float = 30.0,
+                     retry_s: float = 0.0) -> tuple[bytes, int]:
+    """``GET /job/<id>/checkpoint`` with gzip transport negotiated:
+    returns ``(npz bytes, wire bytes)``. Shared by ``tts migrate`` and
+    the fleet router's checkpoint pulls. Raises ``HTTPError`` (409: no
+    checkpoint yet) or ``URLError`` past the retry deadline."""
+
+    def do():
+        # Ask for gzip transport: urllib neither advertises nor decodes
+        # it on its own, so both ends are explicit here. Old daemons
+        # ignore the header and send identity — both shapes are handled.
+        req = Request(base + f"/job/{jid}/checkpoint",
+                      headers={"Accept-Encoding": "gzip"})
+        with urlopen(req, timeout=timeout) as resp:  # noqa: S310
+            raw = resp.read()
+            wire = len(raw)
+            if resp.headers.get("Content-Encoding") == "gzip":
+                import gzip
+
+                raw = gzip.decompress(raw)
+            return raw, wire
+
+    return _retrying(do, retry_s)
 
 
 def spec_from_args(args) -> dict:
@@ -83,15 +138,30 @@ def spec_from_args(args) -> dict:
     return spec
 
 
+def base_url(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
+             router: str | None = None) -> str:
+    """The client's target base URL: the router when ``--router`` (or
+    TTS_ROUTER) names one — every serve endpoint the clients use is
+    proxied 1:1 by the fleet router — else the daemon at host:port."""
+    if router:
+        router = router.rstrip("/")
+        return router if "://" in router else "http://" + router
+    return f"http://{host}:{port}"
+
+
 def submit_main(spec: dict, port: int = DEFAULT_PORT,
                 host: str = "127.0.0.1", wait: bool = False,
-                as_json: bool = False) -> int:
+                as_json: bool = False, router: str | None = None,
+                retry_s: float = 10.0) -> int:
     """Submit a job; with ``wait`` follow it to completion (result record
-    printed — the serve analogue of a ``tts run --json`` line)."""
-    base = f"http://{host}:{port}"
+    printed — the serve analogue of a ``tts run --json`` line). The
+    submit POST retries transient connection failures for ``retry_s``
+    (a restarting daemon/router is a routine fleet event, not an
+    error)."""
+    base = base_url(port, host, router)
     try:
-        code, payload = _post(base + "/submit", spec)
-    except URLError as e:
+        code, payload = _post(base + "/submit", spec, retry_s=retry_s)
+    except (URLError, OSError) as e:
         print(f"Error: no serve daemon at {base}: {e}", file=sys.stderr)
         return 2
     if code != 201:
@@ -105,7 +175,9 @@ def submit_main(spec: dict, port: int = DEFAULT_PORT,
         else:
             print(f"{payload['id']}  class={payload['class']}"
                   f"{' (warm)' if payload.get('warm') else ''}"
-                  f"  position={payload['position']}")
+                  f"  position={payload['position']}"
+                  + (f"  @ {payload['daemon']}"  # routed by a fleet router
+                     if payload.get("daemon") else ""))
         return 0
     rec = follow_job(base, payload["id"],
                      emit=None if as_json else
@@ -193,11 +265,16 @@ def follow_job(base: str, jid: str, emit=None, timeout_s: float = 600.0,
                         emit(payload)
         except (OSError, ValueError):
             pass
-        # Stream dropped: poll the record directly.
+        # Stream dropped: poll the record directly. The poll itself
+        # rides the retry helper — a daemon restarting (or a router
+        # recovering the job onto another daemon) answers again within
+        # seconds, and a watch must survive that window instead of
+        # reporting the job lost.
         try:
-            code, rec = _get(base + f"/job/{jid}")
-        except URLError:
-            return None
+            code, rec = _get(base + f"/job/{jid}", retry_s=10.0)
+        except (URLError, OSError):
+            time.sleep(0.5)
+            continue
         if code == 200 and rec.get("state") in _FINAL:
             return rec
         if code == 404:
@@ -370,6 +447,101 @@ def top_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
         return 0
 
 
+# -- `tts top --router`: the fleet-wide operator console ----------------------
+
+
+def _render_fleet_top(fleet: dict) -> str:
+    """Per-daemon rows + fleet totals from the router's ``/fleet``
+    aggregate (its keeper's last ``/healthz`` + ``/classes`` scrape of
+    every registered daemon)."""
+    router = fleet.get("router") or {}
+    daemons = fleet.get("daemons") or []
+    jobs = fleet.get("jobs") or []
+    lines = [
+        f"tts fleet v{router.get('version', '?')}"
+        f"  up {router.get('uptime_s', 0):.0f}s"
+        f"  daemons={router.get('daemons_healthy', 0)}"
+        f"/{router.get('daemons', 0)} healthy"
+        f"  jobs={router.get('jobs', 0)}"
+        + ("" if router.get("ok") else "  [DEGRADED: no healthy daemon]")
+    ]
+    lines.append("")
+    lines.append(f"{'daemon':<28} {'state':<8} {'queue':>5} {'work':>6} "
+                 f"{'warm':>4} {'cls':>3} {'pool':>8} {'jobs':<24}")
+    tot_queue = tot_warm = tot_cls = tot_pool = 0
+    for d in daemons:
+        h = d.get("health") or {}
+        classes = d.get("classes") or []
+        warm = sum(1 for c in classes if c.get("warm"))
+        pool = sum(int(c.get("pool_bytes", 0) or 0) for c in classes)
+        state = ("drain" if d.get("draining")
+                 else "ok" if d.get("healthy")
+                 else f"dead({d.get('misses', 0)})")
+        by_state = d.get("jobs_by_state") or {}
+        tot_queue += int(h.get("queue_depth", 0) or 0)
+        tot_warm += warm
+        tot_cls += len(classes)
+        tot_pool += pool
+        lines.append(
+            f"{d.get('url', '?')[:28]:<28} {state:<8} "
+            f"{h.get('queue_depth', 0):>5} "
+            f"{h.get('workers_alive', '?')}/{h.get('workers', '?'):>4} "
+            f"{warm:>4} {len(classes):>3} {_fmt_bytes(pool):>8} "
+            + (" ".join(f"{s}={n}" for s, n in sorted(by_state.items()))
+               or "-"))
+    lines.append(
+        f"{'TOTAL':<28} {'':<8} {tot_queue:>5} {'':>6} "
+        f"{tot_warm:>4} {tot_cls:>3} {_fmt_bytes(tot_pool):>8}")
+    active = [j for j in jobs
+              if j.get("state") not in _FINAL]
+    finished = [j for j in jobs if j not in active]
+    rows = active + finished[-5:]
+    if rows:
+        lines.append("")
+        lines.append(f"{'fleet job':<12} {'state':<9} {'daemon':<24} "
+                     f"{'class':<30} {'steps':>8} {'moves':>5}")
+        for j in rows:
+            lines.append(
+                f"{j.get('id', '?'):<12} {j.get('state') or '?':<9} "
+                f"{(j.get('daemon') or '?')[:24]:<24} "
+                f"{(j.get('class') or '?')[:30]:<30} "
+                f"{j.get('steps', 0):>8} {j.get('resubmits', 0):>5}")
+    return "\n".join(lines)
+
+
+def fleet_top_main(router: str, interval: float = 2.0, once: bool = False,
+                   as_json: bool = False) -> int:
+    """``tts top --router URL``: the fleet-wide console — per-daemon
+    rows aggregated from the router keeper's scrapes plus fleet totals.
+    ``--once``/``--json`` mirror the single-daemon ``tts top`` (CI
+    smoke)."""
+    base = base_url(router=router)
+    try:
+        while True:
+            try:
+                code, fleet = _get(base + "/fleet", timeout=5.0,
+                                   retry_s=5.0)
+            except (URLError, OSError) as e:
+                print(f"Error: no fleet router at {base}: {e}",
+                      file=sys.stderr)
+                return 2
+            if code != 200:
+                print(f"Error: /fleet failed ({code}): {fleet}",
+                      file=sys.stderr)
+                return 2
+            if as_json:
+                print(json.dumps(fleet), flush=True)
+            else:
+                if not once and sys.stdout.isatty():
+                    print("\x1b[2J\x1b[H", end="")
+                print(_render_fleet_top(fleet), flush=True)
+            if once:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 # -- `tts migrate`: cross-daemon job migration --------------------------------
 
 
@@ -416,18 +588,7 @@ def migrate_main(jid: str, to_url: str, port: int = DEFAULT_PORT,
               file=sys.stderr)
         return 2
     try:
-        # Ask for gzip transport: urllib neither advertises nor decodes
-        # it on its own, so both ends are explicit here. Old daemons
-        # ignore the header and send identity — both shapes are handled.
-        req = Request(base + f"/job/{jid}/checkpoint",
-                      headers={"Accept-Encoding": "gzip"})
-        with urlopen(req, timeout=30.0) as resp:  # noqa: S310
-            raw = resp.read()
-            wire_bytes = len(raw)
-            if resp.headers.get("Content-Encoding") == "gzip":
-                import gzip
-
-                raw = gzip.decompress(raw)
+        raw, wire_bytes = fetch_checkpoint(base, jid)
     except (URLError, OSError) as e:
         print(f"Error: checkpoint fetch failed: {e}", file=sys.stderr)
         return 2

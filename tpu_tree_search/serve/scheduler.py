@@ -87,11 +87,21 @@ class Scheduler:
 
     def __init__(self, registry, pool, workers: int = 1,
                  quantum_s: float = 5.0, state_dir: str = ".",
-                 metrics=None, batch_slots: int | None = None):
+                 metrics=None, batch_slots: int | None = None,
+                 ckpt_every_s: float | None = None):
         self.registry = registry
         self.pool = pool
         self.workers = max(1, int(workers))
         self.quantum_s = float(quantum_s)
+        if ckpt_every_s is None:
+            ckpt_every_s = float(os.environ.get("TTS_CKPT_EVERY", "0") or 0)
+        # Periodic recoverability cuts (``--ckpt-every`` / TTS_CKPT_EVERY,
+        # 0 = off): the slice yield_fn fires every ckpt_every_s even with
+        # nothing waiting, so the job's checkpoint + exact step count hit
+        # disk together at each cut — the fleet router pulls those to
+        # survive a SIGKILLed daemon. Host-side policy only: the engine
+        # call itself is unchanged (checkpoint_interval_s stays cut-only).
+        self.ckpt_every_s = float(ckpt_every_s) or None
         self.state_dir = state_dir
         if batch_slots is None:
             batch_slots = int(os.environ.get("TTS_BATCH_SLOTS", "1") or 1)
@@ -355,12 +365,16 @@ class Scheduler:
         job.quality.step_offset = job.steps
         ckpt = self._checkpoint_path(job)
         quantum = self.quantum_s
+        every = self.ckpt_every_s
         t0 = time.monotonic()  # restarted below, once the env lease is held
 
         def yield_fn() -> bool:
             if job.cancel_requested or self._stop_requested():
                 return True
-            return (time.monotonic() - t0 >= quantum) and self._waiters()
+            elapsed = time.monotonic() - t0
+            if every is not None and elapsed >= every:
+                return True  # periodic cut: a recoverable checkpoint lands
+            return elapsed >= quantum and self._waiters()
 
         budget = job.spec.get("max_steps")
         kw = dict(

@@ -71,7 +71,8 @@ class ServeDaemon:
     def __init__(self, port: int = DEFAULT_PORT, host: str = "127.0.0.1",
                  state_dir: str | None = None, workers: int = 1,
                  quantum_s: float = 5.0, max_queue: int = 64,
-                 batch_slots: int | None = None):
+                 batch_slots: int | None = None,
+                 ckpt_every_s: float | None = None):
         self.state_dir = state_dir or default_state_dir()
         os.makedirs(self.state_dir, exist_ok=True)
         self.registry = JobRegistry(self.state_dir)
@@ -83,7 +84,8 @@ class ServeDaemon:
                                    quantum_s=quantum_s,
                                    state_dir=self.state_dir,
                                    metrics=self.metrics,
-                                   batch_slots=batch_slots)
+                                   batch_slots=batch_slots,
+                                   ckpt_every_s=ckpt_every_s)
         self.max_queue = max_queue
         self.stop_event = threading.Event()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -183,6 +185,10 @@ class ServeDaemon:
         started = self.scheduler.started
         return {
             "ok": alive > 0 or not started,
+            # The fleet router's keeper reads this to trigger the live
+            # recovery path (migrate-off) while the HTTP surface still
+            # answers, instead of waiting out the death detector.
+            "draining": self.scheduler._stop_requested(),
             "queue_depth": self.scheduler.queue_depth(),
             "jobs": len(self.registry.all()),
             "uptime_s": round(max(0.0, time.time() - self.started), 3),
@@ -277,6 +283,16 @@ class _Handler(BaseHTTPRequestHandler):
                                     "state": job.state}, code=409)
                 elif parts[3] == "checkpoint":
                     path = job.checkpoint
+                    if (not path or not os.path.exists(path)) \
+                            and job.state not in FINAL_STATES:
+                        # Mid-slice fallback: job.checkpoint only updates
+                        # at a cut, but a previous cut's file may already
+                        # sit at the scheduler's well-known path — the
+                        # fleet router's periodic pulls read it from here
+                        # while the job keeps running.
+                        cand = self.daemon.scheduler._checkpoint_path(job)
+                        if os.path.exists(cand):
+                            path = cand
                     if not path or not os.path.exists(path):
                         self.daemon.metrics.inc(
                             "tts_serve_conflicts_total",
@@ -394,9 +410,16 @@ def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
                state_dir: str | None = None, workers: int = 1,
                quantum_s: float = 5.0, max_queue: int = 64,
                warm: str | None = None,
-               batch_slots: int | None = None) -> int:
+               batch_slots: int | None = None,
+               ckpt_every_s: float | None = None,
+               router: str | None = None) -> int:
     """The ``tts serve`` entry point: start, optionally pre-warm the pool,
     then wait for SIGTERM/SIGINT (or POST /shutdown) and drain.
+
+    ``--router URL`` self-registers this daemon with a fleet router
+    (fleet/router.py) once the HTTP surface is up; registration failure
+    is reported, not fatal — the daemon serves standalone and the router
+    can still be pointed at it later via POST /register.
 
     Signal composition: the daemon's handler is installed FIRST, so a
     later ``flightrec.install()`` (TTS_FLIGHTREC=1 operators) dumps its
@@ -404,7 +427,8 @@ def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
     flight-record dump and a clean drain."""
     daemon = ServeDaemon(port=port, host=host, state_dir=state_dir,
                          workers=workers, quantum_s=quantum_s,
-                         max_queue=max_queue, batch_slots=batch_slots)
+                         max_queue=max_queue, batch_slots=batch_slots,
+                         ckpt_every_s=ckpt_every_s)
 
     def _on_signal(signum, frame):
         # Handler context: just set the flag; the main loop drains.
@@ -424,6 +448,21 @@ def serve_main(port: int = DEFAULT_PORT, host: str = "127.0.0.1",
           f"batch-slots: {daemon.scheduler.batch_slots}"
           + (f", reloaded {daemon.loaded} job record(s)" if daemon.loaded
              else "") + ")", flush=True)
+    if router:
+        from .client import _post, base_url
+
+        try:
+            code, resp = _post(base_url(router=router) + "/register",
+                               {"url": daemon.url}, timeout=5.0,
+                               retry_s=5.0)
+            print(f"Registered with fleet router {router} "
+                  f"({resp.get('daemons', '?')} daemon(s) in fleet)"
+                  if code == 200 else
+                  f"Fleet registration rejected ({code}): {resp}",
+                  flush=True)
+        except (OSError, ValueError) as e:
+            print(f"Fleet registration with {router} failed ({e}); "
+                  "serving standalone.", flush=True)
     if warm is not None:
         from .warmup import warm_pool
 
