@@ -1346,10 +1346,14 @@ def _megakernel_ab_rows(extras: list, on_tpu: bool) -> None:
     ``TTS_MEGAKERNEL=force`` arms the fused Pallas cycle in interpret mode
     (same program structure, reference semantics) and every count must be
     bit-identical to the off build — no timing claim, interpret wall time
-    means nothing. On TPU the row adds the timed A/B on ta014 lb1 at a
-    small-M pool-resident config (M*n inside the auto window): off vs
-    force nodes/s, speedup, and golden parity for both arms — the number
-    the round-6 keep/retire bars judge."""
+    means nothing. A third arm forces the STREAMED grid form
+    (``TTS_MEGAKERNEL_MT``) under the same gate, and an M-ladder records
+    the auto resolver's decision per pool-size rung (the past-2^16 rung
+    must arm tiled). On TPU the row adds the timed A/B/tiled triple on
+    ta014 lb1 at M=1024 — off vs force vs tiled nodes/s, speedups, golden
+    parity for all arms, and a phase-profiled roofline audit per arm
+    (``*_roofline_mem``, obs/roofline.py) — the numbers the round-6
+    keep/retire bars judge."""
     from tpu_tree_search.engine.resident import resident_search
     from tpu_tree_search.problems import NQueensProblem, PFSPProblem
 
@@ -1371,41 +1375,121 @@ def _megakernel_ab_rows(extras: list, on_tpu: bool) -> None:
                 off = resident_search(mk(), m=5, M=64, K=8)
             with _env_override("TTS_MEGAKERNEL", "force"):
                 on = resident_search(mk(), m=5, M=64, K=8)
+            # Streamed-grid arm: a forced Mt=16 at M=64 tiles the pool
+            # axis 4-wide — the double-buffered HBM->VMEM form must stay
+            # bit-identical to both the off build and the single-tile arm.
+            with _env_override("TTS_MEGAKERNEL", "force"), \
+                    _env_override("TTS_MEGAKERNEL_MT", "16"):
+                tiled = resident_search(mk(), m=5, M=64, K=8)
             ok = (
                 on.megakernel == "on"
                 and (on.explored_tree, on.explored_sol, on.best)
                 == (off.explored_tree, off.explored_sol, off.best)
             )
+            tok = (
+                tiled.megakernel == "on" and tiled.megakernel_tiled
+                and tiled.megakernel_mt == 16
+                and (tiled.explored_tree, tiled.explored_sol, tiled.best)
+                == (off.explored_tree, off.explored_sol, off.best)
+            )
             row[f"{name}_parity"] = ok
+            row[f"{name}_tiled_parity"] = tok
             if not ok:
                 row[f"{name}_reason"] = on.megakernel_reason
-            parity = parity and ok
+            if not tok:
+                row[f"{name}_tiled_reason"] = tiled.megakernel_reason
+            parity = parity and ok and tok
         row["parity"] = parity
+
+        # -- pool-size ladder (the streamed/tiled axis evidence) ----------
+        # Decision rows at every rung: what the AUTO resolver does at this
+        # M (patching the backend gate on so the rows mean the same thing
+        # on- and off-chip) — the past-2^16 rung must arm TILED with a
+        # recorded Mt, the refusal the streaming rewrite removed. The
+        # smallest rung also EXECUTES the off/tiled pair off-chip as a
+        # parity fact (interpret wall time means nothing; the timed
+        # evidence stays on the TPU rows below).
+        from tpu_tree_search.ops import megakernel as MK
+
+        ladder = []
+        orig_on_tpu = MK._on_tpu
+        MK._on_tpu = (lambda device=None: True) if not on_tpu else orig_on_tpu
+        try:
+            for Mr in (4096, 16384, 65536):
+                entry = {"M": Mr}
+                dec = MK.resolve(NQueensProblem(N=10), Mr)
+                entry["auto_enabled"] = dec.enabled
+                entry["auto_mt"] = dec.mt
+                entry["auto_grid"] = dec.grid
+                if dec.reason:
+                    entry["auto_reason"] = dec.reason
+                ladder.append(entry)
+        finally:
+            MK._on_tpu = orig_on_tpu
+        if parity:
+            Mr = 4096
+            with _env_override("TTS_MEGAKERNEL", "0"):
+                off = resident_search(NQueensProblem(N=10), m=5, M=Mr, K=2)
+            with _env_override("TTS_MEGAKERNEL", "force"), \
+                    _env_override("TTS_MEGAKERNEL_MT", str(Mr // 4)):
+                tiled = resident_search(
+                    NQueensProblem(N=10), m=5, M=Mr, K=2)
+            ladder[0]["exec_tiled_parity"] = (
+                tiled.megakernel == "on" and tiled.megakernel_tiled
+                and (tiled.explored_tree, tiled.explored_sol)
+                == (off.explored_tree, off.explored_sol)
+            )
+        row["m_ladder"] = ladder
         if on_tpu and parity:
-            prob = PFSPProblem(inst=14, lb="lb1", ub=1)
+            import contextlib
+
             timed = {}
-            for label, env in (("off", "0"), ("force", "force")):
-                with _env_override("TTS_MEGAKERNEL", env):
+            # Third arm: forced Mt=256 at M=1024 streams the pool 4-wide —
+            # the grid form's timed number next to the single-tile one.
+            for label, env, mt in (("off", "0", None),
+                                   ("force", "force", None),
+                                   ("tiled", "force", "256")):
+                with contextlib.ExitStack() as stack:
+                    stack.enter_context(
+                        _env_override("TTS_MEGAKERNEL", env))
+                    if mt is not None:
+                        stack.enter_context(
+                            _env_override("TTS_MEGAKERNEL_MT", mt))
                     resident_search(PFSPProblem(inst=14, lb="lb1", ub=1),
                                     m=25, M=1024)  # warm/compile
                     t0 = time.perf_counter()
                     res = resident_search(
                         PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=1024)
                     wall = time.perf_counter() - t0
+                    # Separate phase-profiled pass: the roofline audit
+                    # needs the phase clocks, whose instrumented build
+                    # must never time the A/B arms themselves.
+                    stack.enter_context(
+                        _env_override("TTS_PHASEPROF", "1"))
+                    prof = resident_search(
+                        PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=1024)
                 timed[label] = (res, wall)
                 row[f"{label}_s"] = round(wall, 3)
                 row[f"{label}_nodes_per_sec"] = round(
                     res.explored_tree / max(wall, 1e-9), 1)
                 row[f"{label}_megakernel"] = res.megakernel
+                if res.megakernel_mt:
+                    row[f"{label}_mt"] = res.megakernel_mt
                 if res.megakernel_reason:
                     row[f"{label}_reason"] = res.megakernel_reason
+                if prof.roofline is not None:
+                    row[f"{label}_roofline_mem"] = prof.roofline
             row["speedup"] = round(
                 timed["off"][1] / max(timed["force"][1], 1e-9), 3)
+            row["speedup_tiled"] = round(
+                timed["off"][1] / max(timed["tiled"][1], 1e-9), 3)
             row["tpu_parity"] = (
                 (timed["off"][0].explored_tree, timed["off"][0].explored_sol,
                  timed["off"][0].best)
                 == (timed["force"][0].explored_tree,
                     timed["force"][0].explored_sol, timed["force"][0].best)
+                == (timed["tiled"][0].explored_tree,
+                    timed["tiled"][0].explored_sol, timed["tiled"][0].best)
             )
         extras.append(row)
     except Exception as e:  # noqa: BLE001 — A/B rows never fail a bench
@@ -1773,6 +1857,14 @@ def _main(partial: BenchPartial) -> int:
         }
         if res.megakernel_reason:
             record["megakernel_reason"] = res.megakernel_reason
+        if res.megakernel_mt:
+            record["megakernel_mt"] = res.megakernel_mt
+            record["megakernel_tiled"] = res.megakernel_tiled
+        if res.roofline is not None:
+            # Memory-roofline audit (obs/roofline.py) — distinct from the
+            # FLOP-MFU "roofline" key above: per-phase %-of-memory-bound
+            # peak when the headline ran phase-profiled.
+            record["roofline_mem"] = res.roofline
         if compact_stats is not None:
             record["compact"] = compact_stats
         # Measured kernel-only throughput on the same chunk shape: the

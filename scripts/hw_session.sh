@@ -195,8 +195,9 @@ timeout 900 python scripts/cycle_profile.py --M 65536 --cycles 16 || true
 
 echo "== 8b/9 one-kernel cycle A/B (megakernel keep/retire evidence) =="
 # The ISSUE 13 decision row (docs/HW_VALIDATION.md keep/retire procedure):
-# ta014 lb1 at the small-M pool-resident config, off vs force, guard
-# armed — golden parity asserted inline, timed rows banked in
+# ta014 lb1 at the small-M pool-resident config, off vs force vs the
+# streamed tiled arm (ISSUE 19, TTS_MEGAKERNEL_MT), guard armed — golden
+# parity asserted inline, timed + roofline rows banked in
 # MEGAKERNEL_AB.json. A Mosaic lowering failure or a slowdown here is
 # the retire signal (the lb1-Pallas precedent); parity breakage is a bug.
 TTS_GUARD=1 timeout 900 python - <<'EOF' | tee MEGAKERNEL_AB.json \
@@ -207,8 +208,16 @@ from tpu_tree_search.problems import PFSPProblem
 
 GOLDEN = None
 row = {"metric": "megakernel_ab_hw", "m": 25, "M": 1024}
-for label, knob in (("off", "0"), ("force", "force")):
+# Third arm: the STREAMED grid form (ISSUE 19) — forced Mt=256 tiles the
+# M=1024 pool 4-wide through the double-buffered HBM->VMEM pipeline; its
+# timed row next to the single-tile one is the streaming keep/retire
+# evidence, and a phase-profiled pass banks the roofline audit per arm.
+for label, knob, mt in (("off", "0", None), ("force", "force", None),
+                        ("tiled", "force", "256")):
     os.environ["TTS_MEGAKERNEL"] = knob
+    os.environ.pop("TTS_MEGAKERNEL_MT", None)
+    if mt is not None:
+        os.environ["TTS_MEGAKERNEL_MT"] = mt
     resident_search(PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=1024)
     t0 = time.perf_counter()
     res = resident_search(PFSPProblem(inst=14, lb="lb1", ub=1), m=25, M=1024)
@@ -220,9 +229,19 @@ for label, knob in (("off", "0"), ("force", "force")):
     row[f"{label}_s"] = round(wall, 3)
     row[f"{label}_nodes_per_sec"] = round(res.explored_tree / wall, 1)
     row[f"{label}_megakernel"] = res.megakernel
+    if res.megakernel_mt:
+        row[f"{label}_mt"] = res.megakernel_mt
     if res.megakernel_reason:
         row[f"{label}_reason"] = res.megakernel_reason
+    os.environ["TTS_PHASEPROF"] = "1"
+    prof = resident_search(PFSPProblem(inst=14, lb="lb1", ub=1),
+                           m=25, M=1024)
+    os.environ.pop("TTS_PHASEPROF", None)
+    if prof.roofline is not None:
+        row[f"{label}_roofline_mem"] = prof.roofline
+os.environ.pop("TTS_MEGAKERNEL_MT", None)
 row["speedup"] = round(row["off_s"] / max(row["force_s"], 1e-9), 3)
+row["speedup_tiled"] = round(row["off_s"] / max(row["tiled_s"], 1e-9), 3)
 print(json.dumps(row))
 EOF
 # Phase split of the ARMED run: the fused cycle collapses the in-cycle

@@ -167,6 +167,20 @@ def test_all_tiers_match_sequential_megakernel_axis(seed, lb, monkeypatch):
     _fuzz_all_tiers(seed, lb)
 
 
+@pytest.mark.slow  # every tier recompiles under force+Mt; CI tests-megakernel runs it unfiltered
+@pytest.mark.parametrize("seed,lb", [(173, "lb1"), (179, "lb2")])
+def test_all_tiers_match_sequential_megakernel_tiled_axis(seed, lb,
+                                                          monkeypatch):
+    """Streamed-grid axis (ops/megakernel.py TTS_MEGAKERNEL_MT): a forced
+    Mt=16 tiles every tier's M=64 pool 4-wide through the double-buffered
+    grid — per-tile compaction plus the SMEM-carried cross-tile offset
+    must land the sequential counts on every tier, armed or refused.
+    Streaming changes how the cycle's bytes move, never what it counts."""
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    monkeypatch.setenv("TTS_MEGAKERNEL_MT", "16")
+    _fuzz_all_tiers(seed, lb)
+
+
 @pytest.mark.slow  # every tier recompiles per TTS_NARROW token; CI tests-narrow runs it unfiltered
 @pytest.mark.parametrize("mode", ["0", "auto"])
 def test_all_tiers_match_sequential_narrow_axis(mode, monkeypatch):

@@ -253,3 +253,146 @@ def test_resolver_refusals_record_reasons():
     # chunk width must keep the sublane tiling exact.
     d = MK.resolve(NQueensProblem(N=8), 60, dev)
     assert not d.enabled and d.reason
+
+
+# -- the streamed/tiled grid (TTS_MEGAKERNEL_MT) ----------------------------
+
+@pytest.mark.parametrize("family", ["nqueens", "pfsp-lb1", "pfsp-lb2"])
+def test_tiled_force_matches_off_bit_identical(family, monkeypatch):
+    """A forced Mt=16 at M=64 streams the pool through a 4-step grid —
+    per-tile dense compaction plus the SMEM-carried cross-tile offset
+    (and the two-phase incumbent fold on PFSP) must land counts
+    bit-identical to the off build, and the SearchResult must record the
+    resolved tile width and the tiled state."""
+    mk = _mk_problem(family)
+    monkeypatch.setenv("TTS_MEGAKERNEL", "0")
+    off = resident_search(mk(), m=4, M=64, K=8)
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    monkeypatch.setenv("TTS_MEGAKERNEL_MT", "16")
+    on = resident_search(mk(), m=4, M=64, K=8)
+    assert on.megakernel == "on", on.megakernel_reason
+    assert on.megakernel_mt == 16 and on.megakernel_tiled
+    assert not off.megakernel_tiled and off.megakernel_mt is None
+    assert _counts(on) == _counts(off)
+
+
+@pytest.mark.slow  # ~70 cut/resume program slices; CI tests-megakernel runs it unfiltered
+def test_tiled_checkpoint_cut_resume_trajectory_matches(tmp_path,
+                                                        monkeypatch):
+    """The streamed grid composes with checkpoint cuts: the full
+    cut/resume counter trajectory under forced Mt=16 is identical to the
+    off build's — the cross-tile carry lives and dies inside one cycle,
+    never across a dispatch boundary."""
+    ptm = _ptm(631, jobs=8)
+
+    def mk():
+        return PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+
+    monkeypatch.setenv("TTS_MEGAKERNEL", "0")
+    t_off = _trajectory(mk, str(tmp_path / "off.ckpt"))
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    monkeypatch.setenv("TTS_MEGAKERNEL_MT", "16")
+    t_on = _trajectory(mk, str(tmp_path / "tiled.ckpt"))
+    assert t_on == t_off
+
+
+def test_auto_window_arms_tiled_past_limit(monkeypatch):
+    """The pool size that used to be the auto refusal boundary now arms
+    TILED: past the single-tile window the resolver streams the pool at a
+    resolved Mt (multiple of 8, divides M) instead of refusing; inside
+    the window the original single-tile form is kept verbatim.  The TPU
+    backend gate is patched on — this is a decision-policy fact, not an
+    execution one."""
+    monkeypatch.setattr(MK, "_on_tpu", lambda device=None: True)
+    prob = NQueensProblem(N=8)
+    n = int(prob.child_slots)
+    small = MK.resolve(prob, 64)
+    assert small.enabled and small.auto
+    assert small.grid == 1 and small.mt == 64
+    M_big = 1 << 16
+    assert M_big * n > MK.SMALL_M_LIMIT  # past the old refusal boundary
+    d = MK.resolve(prob, M_big)
+    assert d.enabled and d.auto, d.reason
+    assert d.tiled and d.grid > 1
+    assert d.mt % 8 == 0 and M_big % d.mt == 0
+    assert d.grid == M_big // d.mt
+
+
+def test_mt_misalignment_refuses_and_bad_value_raises(monkeypatch):
+    """A tile width that does not divide M is a recorded refusal (the run
+    falls back bit-correct), held even under force; a non-integer or
+    non-positive width is an operator error and raises."""
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    monkeypatch.setenv("TTS_MEGAKERNEL_MT", "24")  # %8 ok, 64 % 24 != 0
+    mk = _mk_problem("pfsp-lb1")
+    opt = sequential_search(mk()).best
+    seq = sequential_search(mk(), initial_best=opt)
+    res = resident_search(mk(), m=4, M=64, K=8, initial_best=opt)
+    assert res.megakernel == "off"
+    assert res.megakernel_reason and "divide" in res.megakernel_reason
+    assert _counts(res) == _counts(seq)
+    for bad in ("abc", "0", "-8"):
+        monkeypatch.setenv("TTS_MEGAKERNEL_MT", bad)
+        with pytest.raises(ValueError):
+            MK.megakernel_mt()
+
+
+def test_mt_knob_flip_rebuilds_and_reset_hits_cache(monkeypatch):
+    """TTS_MEGAKERNEL_MT rides the routing token: under force a pinned
+    width builds a DISTINCT program (tiled vs single-tile cycle bodies),
+    and unsetting it again hits the original cached program."""
+    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
+
+    prob = NQueensProblem(N=8)
+    dev = jax.devices()[0]
+    monkeypatch.setenv("TTS_MEGAKERNEL", "force")
+    monkeypatch.delenv("TTS_MEGAKERNEL_MT", raising=False)
+    capacity, M = resolve_capacity(prob, 64, None)
+    a = _make_program(prob, 5, M, 4, capacity, dev)
+    assert a.megakernel.enabled and a.megakernel.grid == 1
+    monkeypatch.setenv("TTS_MEGAKERNEL_MT", "16")
+    b = _make_program(prob, 5, M, 4, capacity, dev)
+    assert a is not b
+    assert b.megakernel.tiled and b.megakernel.mt == 16
+    monkeypatch.delenv("TTS_MEGAKERNEL_MT", raising=False)
+    c = _make_program(prob, 5, M, 4, capacity, dev)
+    assert c is a  # cache hit — the unset-knob build really is the same
+
+
+# -- the Megacore evaluation-only split -------------------------------------
+
+def test_streamed_eval_bounds_matches_oracle():
+    """The parallel-semantics evaluation pass: multi-tile output is
+    bit-identical to single-tile (tile independence — the property that
+    makes the Megacore split legal), and the lb1 plane matches the
+    fused-jnp evaluator oracle on open slots."""
+    ptm = _ptm(311)
+    prob = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    n = prob.jobs
+    rng = np.random.default_rng(7)
+    B = 64
+    prmu = np.stack([rng.permutation(n) for _ in range(B)]).astype(np.int32)
+    lim = rng.integers(-1, n - 2, size=B).astype(np.int32)
+    one = np.asarray(MK.streamed_eval_bounds(prob, prmu, lim, interpret=True))
+    for mt in (8, 16, 32):
+        tiled = np.asarray(MK.streamed_eval_bounds(
+            prob, prmu, lim, mt=mt, interpret=True))
+        np.testing.assert_array_equal(tiled, one)
+    t = prob.device_tables()
+    want = np.asarray(PD.lb1_bounds(
+        jnp.asarray(prmu), jnp.asarray(lim), t))
+    open_ = np.arange(n)[None, :] > lim[:, None]
+    np.testing.assert_array_equal(one[open_], want[open_])
+    # tile-width validation is an operator error, not a refusal
+    with pytest.raises(ValueError):
+        MK.streamed_eval_bounds(prob, prmu, lim, mt=24, interpret=True)
+    # N-Queens label plane: tile independence on the other family shape.
+    nq = NQueensProblem(N=8)
+    board = rng.integers(0, 8, size=(B, nq.child_slots)).astype(np.int32)
+    depth = rng.integers(0, 4, size=B).astype(np.int32)
+    nq_one = np.asarray(MK.streamed_eval_bounds(
+        nq, board, depth, interpret=True))
+    nq_tiled = np.asarray(MK.streamed_eval_bounds(
+        nq, board, depth, mt=16, interpret=True))
+    np.testing.assert_array_equal(nq_tiled, nq_one)
+    assert nq_one.shape == board.shape

@@ -1,0 +1,186 @@
+"""Memory-roofline audit (obs/roofline.py; `tts report --roofline`).
+
+The byte-floor math, the peak-bandwidth resolution order (TTS_HBM_GBPS >
+COSTMODEL `hbm` link > nominal backend table), the audit/table shapes, the
+SearchResult.roofline field of a phase-profiled run, and the golden table
+`tts report --roofline` prints from the committed trace + COSTMODEL
+fixture pair (tests/data/roofline_*.json).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tpu_tree_search import cli
+from tpu_tree_search.obs import roofline as RL
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+TRACE = os.path.join(DATA, "roofline_trace.json")
+COSTMODEL = os.path.join(DATA, "roofline_costmodel.json")
+
+
+# -- byte floors ------------------------------------------------------------
+
+def test_phase_byte_floors_off_path_golden():
+    """Off path at (M=64, n=8, S=512, int32 pool): node = 8*4+4 = 36 B;
+    every floor is the hand-derived figure from the module docstring."""
+    f = RL.phase_byte_floors(M=64, n=8, S=512, itemsize=4)
+    node, Mn = 36, 64 * 8
+    assert f == {
+        "pop": 64 * node,
+        "eval": 64 * node + Mn * 4,
+        "compact": Mn * 4 + 512 * 4,
+        "push": 2 * 512 * node,
+        "overflow": 0,
+    }
+
+
+def test_phase_byte_floors_megakernel_charges_eval():
+    """Armed builds charge the whole fused cycle into `eval` (the phase
+    the profiler books it under): streamed tiles in + the (M*n) int32
+    emit + the pool-dtype write-back; compact/push floors are zero."""
+    f = RL.phase_byte_floors(M=64, n=8, S=512, itemsize=1, megakernel=True)
+    node, Mn = 8 * 1 + 4, 64 * 8
+    assert f["pop"] == 64 * node
+    assert f["eval"] == 64 * node + Mn * (8 + 1) * 4 + Mn * node
+    assert f["compact"] == 0 and f["push"] == 0 and f["overflow"] == 0
+
+
+# -- peak resolution order --------------------------------------------------
+
+def test_peak_resolution_order(monkeypatch):
+    entry = {"backend": "cpu", "links": {"hbm": {"per_sec": 25.6e9}}}
+    # nominal fallback
+    monkeypatch.delenv("TTS_HBM_GBPS", raising=False)
+    bps, src = RL.peak_bytes_per_sec("tpu")
+    assert (bps, src) == (RL.NOMINAL_GBPS["tpu"] * 1e9, "nominal:tpu")
+    # a measured costmodel fit beats nominal
+    bps, src = RL.peak_bytes_per_sec("cpu", entry)
+    assert (bps, src) == (25.6e9, "costmodel:hbm")
+    # the env override beats both
+    monkeypatch.setenv("TTS_HBM_GBPS", "100")
+    bps, src = RL.peak_bytes_per_sec("cpu", entry)
+    assert (bps, src) == (100e9, "env:TTS_HBM_GBPS")
+    monkeypatch.setenv("TTS_HBM_GBPS", "-1")
+    with pytest.raises(ValueError):
+        RL.hbm_gbps_override()
+
+
+def test_hbm_entry_picks_backend_match():
+    prof = {
+        "tpu|device-D1|x": {"backend": "tpu",
+                            "links": {"hbm": {"per_sec": 819e9}}},
+        "cpu|device-D1|x": {"backend": "cpu",
+                            "links": {"dispatch": {"per_sec": 17.0}}},
+        "cpu|device-D2|y": {"backend": "cpu",
+                            "links": {"hbm": {"per_sec": 25.6e9}}},
+    }
+    e = RL.hbm_entry(prof, "cpu")
+    assert e["links"]["hbm"]["per_sec"] == 25.6e9
+    assert RL.hbm_entry({"k": {"backend": "cpu", "links": {}}}, "cpu") is None
+
+
+# -- audit math -------------------------------------------------------------
+
+def test_audit_pct_golden():
+    """1 GB moved in 0.1 s against a 100 GB/s peak is 10 GB/s achieved =
+    10% of peak; phases with no time or no floor get no percentage."""
+    phase_ns = {"pop": int(0.1e9), "eval": 0, "overflow": int(1e6)}
+    doc = RL.audit(phase_ns, cycles=1, M=2**25, n=8, S=0, itemsize=4,
+                   peak_bps=100e9, peak_source="env:TTS_HBM_GBPS")
+    rows = {r["phase"]: r for r in doc["phases"]}
+    pop = rows["pop"]
+    assert pop["bytes"] == 2**25 * (8 * 4 + 4)
+    want_gbps = pop["bytes"] / 0.1 / 1e9
+    assert pop["gbps"] == round(want_gbps, 2)
+    assert pop["pct_of_peak"] == round(100.0 * want_gbps / 100.0, 1)
+    assert "pct_of_peak" not in rows["eval"]      # no measured time
+    assert "pct_of_peak" not in rows["overflow"]  # no byte floor
+    assert doc["peak_gbps"] == 100.0 and doc["cycles"] == 1
+
+
+def test_table_shape():
+    doc = RL.audit({"pop": int(1e6)}, cycles=2, M=64, n=8, S=64,
+                   itemsize=4, peak_bps=40e9, peak_source="nominal:cpu")
+    lines = RL.table(doc)
+    assert "peak 40.0 GB/s" in lines[0] and "2 cycles" in lines[0]
+    assert any(line.lstrip().startswith("pop") for line in lines)
+    assert len(lines) == 2 + len(RL.PHASES)
+
+
+# -- the engine surface -----------------------------------------------------
+
+def test_search_result_roofline_armed_by_phaseprof(monkeypatch):
+    from tpu_tree_search.engine.resident import resident_search
+    from tpu_tree_search.problems import NQueensProblem
+
+    res = resident_search(NQueensProblem(N=8), m=4, M=64, K=8)
+    assert res.roofline is None  # profiler off -> no payload
+    monkeypatch.setenv("TTS_PHASEPROF", "1")
+    res = resident_search(NQueensProblem(N=8), m=4, M=64, K=8)
+    assert res.roofline is not None
+    assert res.roofline["cycles"] > 0
+    assert res.roofline["peak_source"].startswith(("nominal:", "env:",
+                                                   "costmodel:"))
+    rows = {r["phase"]: r for r in res.roofline["phases"]}
+    assert set(rows) == set(RL.PHASES)
+    assert rows["pop"]["bytes"] > 0
+
+
+# -- the report surface (committed fixture pair) ----------------------------
+
+def test_report_roofline_golden_table(capsys):
+    """The committed phase-profiled trace + COSTMODEL pair prints the
+    full table with the costmodel-resolved peak — the shape of every row
+    is golden (floors are facts of the recorded meta, not of this host)."""
+    assert cli.main(["report", TRACE, "--roofline",
+                     "--costmodel", COSTMODEL]) == 0
+    out = capsys.readouterr().out
+    assert "roofline (peak 25.6 GB/s, costmodel:hbm; 36 cycles):" in out
+    assert "phase       time_ms     floor_MB    GB/s     % of peak" in out
+    for slot in RL.PHASES:
+        assert f"\n    {slot}" in out
+    # the overflow row reports time only — never a made-up percentage
+    # (the 4-space indent is the roofline table; the 2-space "overflow
+    # branch" row above it belongs to the phase-decomp table)
+    over = [ln for ln in out.splitlines()
+            if ln.startswith("    overflow")][0]
+    assert over.rstrip().endswith("-")
+
+
+def test_report_roofline_json_fields(capsys):
+    assert cli.main(["report", TRACE, "--roofline",
+                     "--costmodel", COSTMODEL, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    rl = doc["roofline"]
+    assert rl["peak_source"] == "costmodel:hbm" and rl["cycles"] == 36
+    assert {r["phase"] for r in rl["phases"]} == set(RL.PHASES)
+
+
+def test_report_roofline_nominal_without_costmodel(capsys):
+    """Without --costmodel the peak falls back to the nominal table for
+    the recorded backend (the fixture ran on cpu)."""
+    assert cli.main(["report", TRACE, "--roofline"]) == 0
+    assert "nominal:cpu" in capsys.readouterr().out
+
+
+def test_report_roofline_requires_profiled_trace(tmp_path, capsys):
+    """--roofline on a trace without phase clocks is a hard exit 2 with a
+    diagnostic; the same trace without the flag still reports fine."""
+    evts = [{"name": "dispatch", "ph": "X", "ts": 0.0, "dur": 100.0,
+             "pid": 0, "tid": 0, "args": {"cycles": 4}}]
+    p = tmp_path / "plain.json"
+    p.write_text(json.dumps({"traceEvents": evts}))
+    assert cli.main(["report", str(p)]) == 0
+    capsys.readouterr()
+    assert cli.main(["report", str(p), "--roofline"]) == 2
+    assert "phase-profiled" in capsys.readouterr().err
+
+
+def test_report_bad_costmodel_exits_2(tmp_path, capsys):
+    assert cli.main(["report", TRACE, "--roofline",
+                     "--costmodel", str(tmp_path / "nope.json")]) == 2
+    assert "cost model" in capsys.readouterr().err

@@ -54,8 +54,9 @@ KNOBS = (
     "TTS_COMPACT", "TTS_OBS", "TTS_PHASEPROF", "TTS_LB2_PAIRBLOCK",
     "TTS_PIPELINE", "TTS_K", "TTS_GUARD", "TTS_PALLAS", "TTS_PALLAS_LB2",
     "TTS_LB2_STAGED", "TTS_XLA_TRACE", "TTS_FLIGHTREC", "TTS_COSTMODEL",
-    "TTS_QUALITY", "TTS_MEGAKERNEL", "TTS_STEAL", "TTS_PODS",
-    "TTS_SIM_LAT_ICI", "TTS_SIM_LAT_DCN", "TTS_NARROW",
+    "TTS_QUALITY", "TTS_MEGAKERNEL", "TTS_MEGAKERNEL_MT", "TTS_STEAL",
+    "TTS_PODS", "TTS_SIM_LAT_ICI", "TTS_SIM_LAT_DCN", "TTS_NARROW",
+    "TTS_HBM_GBPS",
 )
 
 #: Matrix axes (the lb2 families add the pair-block axis).
@@ -113,6 +114,10 @@ class Cell:
     # "force" pins the one-kernel cycle (ops/megakernel.py) armed, or the
     # refusal fallback where the family cannot arm (pfsp-lb1d).
     megakernel: str | None = None
+    # None = TTS_MEGAKERNEL_MT unset (keys stay byte-stable); a width
+    # pins the streamed pool-tile axis so the grid form (grid = M/Mt > 1)
+    # gets its own audited cell.
+    mt: str | None = None
 
     @property
     def key(self) -> str:
@@ -121,6 +126,8 @@ class Cell:
             s += f"|pb={self.pairblock}"
         if self.megakernel is not None:
             s += f"|mk={self.megakernel}"
+        if self.mt is not None:
+            s += f"|mt={self.mt}"
         return s
 
     def env(self) -> dict[str, str]:
@@ -133,6 +140,8 @@ class Cell:
             e["TTS_LB2_PAIRBLOCK"] = self.pairblock
         if self.megakernel is not None:
             e["TTS_MEGAKERNEL"] = self.megakernel
+        if self.mt is not None:
+            e["TTS_MEGAKERNEL_MT"] = self.mt
         return e
 
 
@@ -180,6 +189,15 @@ def matrix_cells(families=None, compact=None, obs=None, phaseprof=None,
         for o in obs or OBS_AXIS:
             for ph in phaseprof or PHASEPROF_AXIS:
                 out.append(Cell(fam, "auto", o, ph, pb, megakernel="force"))
+        # Streamed-grid axis (TTS_MEGAKERNEL_MT, ops/megakernel.py): one
+        # tiled force cell per armable family — Mt=16 divides every matrix
+        # M (64/128) so the pool axis genuinely tiles (grid > 1). pfsp-lb1d
+        # is the refusal family; the tile width is inert there and the
+        # force cells above already audit the fallback.
+        if fam != "pfsp-lb1d":
+            out.append(Cell(fam, "auto", (obs or OBS_AXIS)[0],
+                            (phaseprof or PHASEPROF_AXIS)[0], pb,
+                            megakernel="force", mt="16"))
     return out
 
 
@@ -391,6 +409,12 @@ VARIANT_ENVS = {
     "guard1": {"TTS_GUARD": "1"},
     "quality1": {"TTS_QUALITY": "1"},
     "mk0": {"TTS_MEGAKERNEL": "0"},
+    # Streamed-grid axis: off must stay byte-identical under a pinned tile
+    # width (the knob only matters once the kernel arms), and the tiled
+    # force build must keep the off step's outvar signature
+    # (megakernel-tiled-identity, ops/megakernel.py).
+    "mk0-mt": {"TTS_MEGAKERNEL": "0", "TTS_MEGAKERNEL_MT": "16"},
+    "mk-tiled": {"TTS_MEGAKERNEL": "force", "TTS_MEGAKERNEL_MT": "16"},
     "steal-flat": {"TTS_STEAL": "flat"},
     "steal-hier": {"TTS_STEAL": "hier", "TTS_PODS": "2"},
     "narrow0": {"TTS_NARROW": "0"},
@@ -476,6 +500,14 @@ def cache_key_artifact(family: str) -> CacheKeyArtifact:
         "TTS_MEGAKERNEL": (
             build({**base, "TTS_MEGAKERNEL": "0"}),
             build({**base, "TTS_MEGAKERNEL": "force"}),
+        ),
+        # The streamed pool-tile width changes the armed cycle's grid
+        # (single-tile resident vs tiled streaming), so a pinned Mt under
+        # force must build a distinct program from plain force.
+        "TTS_MEGAKERNEL_MT": (
+            build({**base, "TTS_MEGAKERNEL": "force"}),
+            build({**base, "TTS_MEGAKERNEL": "force",
+                   "TTS_MEGAKERNEL_MT": "16"}),
         ),
         # Narrow host storage: the device step jaxpr is knob-inert
         # (`narrow-knob-inert`), but the HOST staging avals the program

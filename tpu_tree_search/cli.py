@@ -234,6 +234,16 @@ def build_parser() -> argparse.ArgumentParser:
                      "summarized as far as they parse)")
     rep.add_argument("--json", action="store_true", dest="report_json",
                      help="emit the summary as one JSON object")
+    rep.add_argument("--roofline", action="store_true",
+                     dest="report_roofline",
+                     help="require the memory-roofline section (per-phase "
+                     "%%-of-peak, obs/roofline.py): exit 2 when the trace "
+                     "was not phase-profiled (TTS_PHASEPROF=1)")
+    rep.add_argument("--costmodel", type=str, default=None,
+                     dest="report_costmodel", metavar="PATH",
+                     help="COSTMODEL.json whose measured `hbm` link fit "
+                     "supplies the roofline peak-bandwidth denominator "
+                     "(else TTS_HBM_GBPS / the nominal backend table)")
 
     prof = sub.add_parser(
         "profile",
@@ -813,7 +823,15 @@ def print_results(args, problem, res) -> None:
     if res.megakernel:
         tag = " (auto)" if res.megakernel_auto else ""
         why = f" — {res.megakernel_reason}" if res.megakernel_reason else ""
-        print(f"One-kernel cycle: {res.megakernel}{tag}{why}")
+        # Armed builds name the streamed pool-tile width and whether the
+        # pool axis actually tiled (ops/megakernel.py Decision): "tiled
+        # Mt=16 x4" is the double-buffered HBM->VMEM streaming form,
+        # "resident Mt=M" the single-tile pool-resident form.
+        tile = ""
+        if res.megakernel == "on" and res.megakernel_mt:
+            form = "tiled" if res.megakernel_tiled else "resident"
+            tile = f", {form} Mt={res.megakernel_mt}"
+        print(f"One-kernel cycle: {res.megakernel}{tag}{tile}{why}")
     if res.k_resolved is not None:
         tag = " (auto)" if res.k_auto else ""
         print(f"Dispatch pipeline: depth={res.pipeline_depth}, "
@@ -952,6 +970,17 @@ def result_record(args, res) -> dict:
                     rec["megakernel_auto"] = True
                 if res.megakernel_reason:
                     rec["megakernel_reason"] = res.megakernel_reason
+                # Armed builds record the streamed pool-tile width and
+                # whether the pool axis tiled — the stats line must prove
+                # WHICH megakernel form (single-tile resident vs streamed
+                # grid) produced the number.
+                if res.megakernel_mt:
+                    rec["megakernel_mt"] = res.megakernel_mt
+                    rec["megakernel_tiled"] = res.megakernel_tiled
+            if res.roofline is not None:
+                # Phase-profiled runs bank the memory-roofline audit
+                # (obs/roofline.py) — per-phase %-of-memory-bound-peak.
+                rec["roofline_mem"] = res.roofline
         if args.problem == "pfsp" and args.lb == "lb2":
             # Staging applies at every mp: under mp > 1 the compacted self
             # bound shards its pair loop with a pmax combine. The job count
@@ -1090,7 +1119,9 @@ def main(argv=None) -> int:
         # Pure trace summarization: no jax import, no backend init.
         from .obs.report import report_main
 
-        return report_main(args.trace, as_json=args.report_json)
+        return report_main(args.trace, as_json=args.report_json,
+                           roofline=args.report_roofline,
+                           costmodel=args.report_costmodel)
     if args.problem == "watch":
         if args.job is not None:
             # Pure HTTP client of a serve daemon: no jax import.

@@ -50,6 +50,7 @@ from ..obs import events as ev
 from ..obs import flightrec as fr
 from ..obs import phases as obs_phases
 from ..obs import quality as obs_quality
+from ..obs import roofline as obs_roofline
 from ..pool import SoAPool
 from ..problems.base import INF_BOUND, Problem
 from ..problems.nqueens import NQueensProblem
@@ -235,19 +236,34 @@ class _ResidentProgram:
                 # + shift-compact + emit run inside ONE pallas_call; the
                 # engine only writes the compacted rows back into the
                 # reserved Mn headroom (rows past tree_inc are dead by the
-                # pool contract). The phase profiler reports the collapse
+                # pool contract). On the streamed path (grid > 1) each of
+                # the G tiles owns an (Mt*n)-row block compacted to its own
+                # front; the blocks are stitched with G overlapping
+                # dynamic_update_slice writes at the kernel's carried
+                # offsets — written in tile order, so each write's garbage
+                # tail is overwritten by the next tile's rows and the live
+                # prefix is exactly the dense-mode global order (single-
+                # tile: G == 1, offs == [0], one full-width write as
+                # before). The phase profiler reports the collapse
                 # honestly: everything lands in `eval`, and the
                 # pop+eval+...+overflow == total telescope still holds.
-                rows_mk, aux_mk, tree_inc, sol_inc, best = mk_cycle(
+                rows_mk, aux_mk, offs_mk, tree_inc, sol_inc, best = mk_cycle(
                     vals_c, aux_c, valid, best
                 )
                 fits = tree_inc <= S  # survivor-budget overflow counter
-                pool_vals = lax.dynamic_update_slice(
-                    pool_vals, rows_mk.astype(vals_dt), (size, jnp.int32(0))
-                )
-                pool_aux = lax.dynamic_update_slice(
-                    pool_aux, aux_mk.astype(aux_dt), (size,)
-                )
+                rows_cast = rows_mk.astype(vals_dt)
+                aux_cast = aux_mk.astype(aux_dt)
+                G_mk = offs_mk.shape[0]
+                Mtn = Mn // G_mk
+                for ti in range(G_mk):
+                    dst = size + offs_mk[ti]
+                    pool_vals = lax.dynamic_update_slice(
+                        pool_vals, rows_cast[ti * Mtn:(ti + 1) * Mtn],
+                        (dst, jnp.int32(0))
+                    )
+                    pool_aux = lax.dynamic_update_slice(
+                        pool_aux, aux_cast[ti * Mtn:(ti + 1) * Mtn], (dst,)
+                    )
                 size = size + tree_inc
                 if phaseprof:
                     ph, (pool_vals, pool_aux, size) = obs_phases.boundary(
@@ -866,6 +882,7 @@ def resident_search(
 
     ctr_total: dict | None = None
     ph_total: dict | None = None  # per-phase ns totals (TTS_PHASEPROF=1)
+    cycles_total = 0  # device chunk cycles consumed (roofline denominator)
     fb_tree = fb_sol = 0  # overflow-fallback host increments (obs parity)
     prev_best = best
     # Anytime quality: None on the off path; otherwise records the
@@ -900,7 +917,7 @@ def resident_search(
 
     def consume(out, t_enq) -> tuple[int, int, int]:
         nonlocal tree2, sol2, size, best, ctr_total, ph_total, prev_best
-        nonlocal n_disp
+        nonlocal n_disp, cycles_total
         t_wait = ev.now_us()
         tree_inc, sol_inc, cycles, size, best, ctr = \
             program.read_scalars(out)
@@ -908,6 +925,7 @@ def resident_search(
         tree2 += tree_inc
         sol2 += sol_inc
         n_disp += 1
+        cycles_total += cycles
         diagnostics.kernel_launches += cycles
         if ctr is not None:
             ctr_total = obs_counters.merge_host(ctr_total, ctr)
@@ -968,6 +986,12 @@ def resident_search(
     ev.emit("pipeline", args={
         "depth": depth, "K": program.K, "k_auto": k_auto, "tier": "resident",
     })
+    if ev.enabled():
+        # Static shape/routing facts for the trace-side roofline audit
+        # (`tts report --roofline`, obs/roofline.py): paired with the
+        # dispatch spans' cycle counts and the device_phases counters, a
+        # trace alone can rebuild the per-phase byte floors.
+        ev.emit("roofline_meta", args=obs_roofline.meta_args(program))
     if band_src is not None:
         ev.emit("costmodel", args={
             "source": band_src, "lo_ms": round(1e3 * band[0], 1),
@@ -1006,11 +1030,15 @@ def resident_search(
                 megakernel=program.megakernel.state,
                 megakernel_auto=program.megakernel.auto,
                 megakernel_reason=program.megakernel.reason,
+                megakernel_mt=program.megakernel.mt or None,
+                megakernel_tiled=program.megakernel.tiled,
                 pipeline_depth=depth,
                 k_resolved=program.K,
                 k_auto=k_auto,
                 obs=obs_result(),
                 phase_profile=ph_total,
+                roofline=obs_roofline.result_audit(
+                    program, ph_total, cycles_total),
                 quality=qt.result() if qt is not None else None,
             )
         if ctl is not None and cycles > 0 and ctl.observe(period, cycles):
@@ -1100,11 +1128,14 @@ def resident_search(
         megakernel=program.megakernel.state,
         megakernel_auto=program.megakernel.auto,
         megakernel_reason=program.megakernel.reason,
+        megakernel_mt=program.megakernel.mt or None,
+        megakernel_tiled=program.megakernel.tiled,
         pipeline_depth=depth,
         k_resolved=program.K,
         k_auto=k_auto,
         obs=obs_result(),
         phase_profile=ph_total,
+        roofline=obs_roofline.result_audit(program, ph_total, cycles_total),
         quality=qt.result() if qt is not None else None,
     )
 

@@ -81,6 +81,19 @@ class SearchResult:
     megakernel: str | None = None
     megakernel_auto: bool = False
     megakernel_reason: str | None = None
+    # Resident tiers, armed builds: the resolved streamed pool-tile width
+    # Mt and whether the pool axis actually tiled (grid > 1 — the
+    # double-buffered HBM->VMEM streaming form; False is the single-tile
+    # pool-resident form). None/False when the kernel is off.
+    megakernel_mt: int | None = None
+    megakernel_tiled: bool = False
+    # Roofline audit (obs/roofline.py): per-phase %-of-memory-bound-peak
+    # computed from the phase_profile ns splits, the analytic per-cycle
+    # byte floors, and the resolved peak HBM bandwidth (COSTMODEL "hbm"
+    # link / TTS_HBM_GBPS / nominal backend table) — {"peak_gbps",
+    # "peak_source", "cycles", "phases": [{phase, ns, bytes, gbps,
+    # pct_of_peak}, ...]}. None when the phase profiler is off.
+    roofline: dict | None = None
     # Resident tiers: dispatch-pipeline depth the host loop ran with
     # (TTS_PIPELINE — 1 = synchronous, >= 2 = speculative), the K the
     # loop ended on, and whether TTS_K=auto resolved it (engine/pipeline.py).

@@ -31,8 +31,13 @@ def _span_us(evts: list[dict]) -> tuple[float, float]:
     return t0, t1
 
 
-def summarize(evts: list[dict], buckets: int = 10) -> dict:
-    """Structured summary of a drained/loaded event list."""
+def summarize(evts: list[dict], buckets: int = 10,
+              costmodel: dict | None = None) -> dict:
+    """Structured summary of a drained/loaded event list.
+
+    ``costmodel`` is an optional loaded COSTMODEL.json profile
+    (obs/costmodel.py) consulted for a measured ``hbm`` link when the
+    trace carries the inputs for a roofline audit."""
     t0, t1 = _span_us(evts)
     span_s = max(t1 - t0, 0.0) / 1e6
 
@@ -206,6 +211,26 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
 
         phase_decomp = phases_mod.decomp(phases_total)
 
+    # -- memory-roofline audit (obs/roofline.py) ---------------------------
+    # Needs three things a phase-profiled trace carries: the static shape/
+    # routing facts (the resident loop's `roofline_meta` event), the
+    # measured phase splits above, and the per-dispatch device cycle
+    # counts. Absent any one of them the section is simply None — the
+    # `--roofline` flag turns that into a hard requirement.
+    roofline = None
+    metas = [e for e in evts if e.get("name") == "roofline_meta"]
+    if metas and phases_total.get("total"):
+        cycles = sum(
+            (e.get("args") or {}).get("cycles", 0) for e in dispatches
+        )
+        if cycles > 0:
+            from . import roofline as roofline_mod
+
+            roofline = roofline_mod.from_meta(
+                metas[-1].get("args") or {}, phases_total, cycles,
+                costmodel=costmodel,
+            )
+
     # -- survivor-path work split (maintenance vs evaluator) ---------------
     # The resident cycle does two kinds of work: the evaluator bounds every
     # candidate child (pushed + leaves + pruned evaluations), and the
@@ -309,6 +334,7 @@ def summarize(evts: list[dict], buckets: int = 10) -> dict:
         "device_counters": counters_total,
         "survivor_path": survivor,
         "phase_decomp": phase_decomp,
+        "roofline": roofline,
         "jobs": job_lanes,
         "quality": quality,
     }
@@ -417,6 +443,10 @@ def render(summary: dict) -> str:
         )
     if summary.get("phase_decomp"):
         out.extend(phase_table(summary["phase_decomp"]))
+    if summary.get("roofline"):
+        from . import roofline as roofline_mod
+
+        out.extend(roofline_mod.table(summary["roofline"]))
     if summary.get("survivor_path"):
         sp = summary["survivor_path"]
         out.append(
@@ -463,7 +493,9 @@ def render(summary: dict) -> str:
     return "\n".join(out)
 
 
-def report_main(trace_paths, as_json: bool = False) -> int:
+def report_main(trace_paths, as_json: bool = False,
+                roofline: bool = False,
+                costmodel: str | None = None) -> int:
     """The ``tts report`` entry point.
 
     Accepts one or many files — traces, metrics JSONL, flight-recorder
@@ -472,13 +504,30 @@ def report_main(trace_paths, as_json: bool = False) -> int:
     Robustness contract: a truncated or empty file is summarized as far
     as it parses, with a warning on stderr and exit 0 — a post-mortem
     artifact from a killed run must never be unreadable by its own
-    tooling. Exit 2 only when NO input could be read at all."""
+    tooling. Exit 2 only when NO input could be read at all.
+
+    ``roofline=True`` (the ``--roofline`` flag) makes the memory-roofline
+    section mandatory: exit 2 with a diagnostic when the trace lacks the
+    phase splits / cycle counts / ``roofline_meta`` facts it needs.
+    ``costmodel`` optionally names a COSTMODEL.json whose measured ``hbm``
+    link fit supplies the peak-bandwidth denominator."""
     import sys
 
     from .export import load_trace_lenient
 
     if isinstance(trace_paths, str):
         trace_paths = [trace_paths]
+    profile = None
+    if costmodel:
+        from . import costmodel as CM
+
+        profile = CM.load(costmodel)
+        if profile is None:
+            # An explicitly named profile that cannot be read is an
+            # operator error here (unlike the controllers' soft fallback).
+            print(f"Error: cannot load cost model {costmodel!r}",
+                  file=sys.stderr)
+            return 2
     evts: list[dict] = []
     readable = 0
     for path in trace_paths:
@@ -498,7 +547,15 @@ def report_main(trace_paths, as_json: bool = False) -> int:
               f"{len(trace_paths)} file(s); reporting empty summary",
               file=sys.stderr)
     evts.sort(key=lambda e: e.get("ts", 0.0))
-    summary = summarize(evts)
+    summary = summarize(evts, costmodel=profile)
+    if roofline and not summary.get("roofline"):
+        print(
+            "Error: --roofline needs a phase-profiled trace "
+            "(TTS_PHASEPROF=1 run with dispatch cycle counts and a "
+            "roofline_meta event); none of the inputs carry one",
+            file=sys.stderr,
+        )
+        return 2
     try:
         if as_json:
             print(json.dumps(summary))

@@ -35,6 +35,15 @@ default) resolves per (problem, M, n, prune-rate regime, backend) from the
 measured table in ``_auto_compact`` — the same self-tuning contract as
 ``--lb2-pairblock auto``; the raw knob rides ``routing_cache_token`` and
 the resolved mode is baked into compiled programs at trace time.
+
+The streamed megakernel (ops/megakernel.py) runs the **tiled** form of
+``dense``: each pool tile of width Mt compacts its own (Mt*n) plane with
+the same LSB-first shifts (rank base 0 per tile), and a cross-tile
+survivor offset carried in SMEM across sequential grid steps restores the
+global dense order when the engine stitches the tiles back at
+``size + offset[t]``.  Per-tile rank + carried base is exactly the global
+dense rank, so the tiled kernel is bit-identical to this module's
+single-shot dense mode (pinned by tests/test_megakernel.py).
 """
 
 from __future__ import annotations

@@ -101,13 +101,16 @@ def _vmem_limit_bytes() -> int | None:
     return mb * 2**20 if mb else None
 
 
-def _compiler_params():
+def _compiler_params(ndims: int = 1, parallel: bool = False):
     # CompilerParams was TPUCompilerParams before jax 0.5 (jax_compat-class
     # rename, handled inline — this module must stay importable without
-    # touching the utils layer).
+    # touching the utils layer). ``ndims`` sizes dimension_semantics to the
+    # grid rank; ``parallel`` marks every grid axis Megacore-splittable
+    # (only safe for carry-free kernels — see megakernel.streamed_eval_bounds).
     cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    sem = ("parallel" if parallel else "arbitrary",) * ndims
     return cls(
-        dimension_semantics=("arbitrary",), vmem_limit_bytes=_vmem_limit_bytes()
+        dimension_semantics=sem, vmem_limit_bytes=_vmem_limit_bytes()
     )
 
 

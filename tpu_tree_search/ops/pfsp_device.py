@@ -31,6 +31,7 @@ All arithmetic is int32 (bounds fit comfortably; max makespan < 2^31).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -779,10 +780,12 @@ def routing_cache_token(problem, device=None) -> tuple:
                   # trace-time routing decision like the rest.
                   PK.pallas_forced(),
                   compact_mode(),
-                  # One-kernel cycle knob (ops/megakernel.py): the raw mode
-                  # — the rest of the decision (M, device, family, mp) is
-                  # already in every program cache key carrying this token.
+                  # One-kernel cycle knobs (ops/megakernel.py): the raw
+                  # mode and the raw forced pool-tile width — the rest of
+                  # the decision (M, device, family, mp) is already in
+                  # every program cache key carrying this token.
                   megakernel_mode(),
+                  os.environ.get("TTS_MEGAKERNEL_MT"),
                   # Narrow node storage (TTS_NARROW, problems/base.py):
                   # host staging dtypes and the megakernel auto window are
                   # trace-time decisions keyed on it.
