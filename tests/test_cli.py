@@ -80,6 +80,12 @@ def test_flag_validation(argv, msg, capsys):
     (7777, "pfsp", "device", "resident", "tpu", 7777),  # explicit wins
     (None, "pfsp", "device", "resident", "tpu", 1024),  # measured default
     (None, "pfsp", "device", "resident", "cpu", 50000),  # unmeasured backend
+    # The GPU row: the reference's published ~50k-node offload chunk
+    # (arXiv 2012.09511 §IV) rounded DOWN to a multiple of 8 (50000 % 8
+    # == 2 would refuse the megakernel/tiled-compaction alignment gates).
+    (None, "pfsp", "device", "resident", "gpu", 49152),
+    (None, "pfsp", "device", "offload", "gpu", 50000),  # non-candidate
+    (None, "nqueens", "device", "resident", "gpu", 50000),  # wide frontier
     (None, "pfsp", "device", "offload", "tpu", 50000),  # per-chunk round trip
     (None, "pfsp", "mesh", "resident", "tpu", 50000),   # sharded: per shard
     (None, "nqueens", "device", "resident", "tpu", 50000),  # wide frontier
@@ -89,6 +95,27 @@ def test_resolve_chunk_size(M, name, tier, engine, backend, expect):
     (docs/HW_VALIDATION.md); explicit values, the offload engine, and
     unmeasured combinations keep the reference's 50000 (`util.chpl`)."""
     assert cli.resolve_chunk_size(M, name, tier, engine, backend) == expect
+    assert cli.resolve_chunk_size(None, "pfsp", "device", "resident",
+                                  "gpu") % 8 == 0
+
+
+def test_resolve_chunk_size_backend_default_tracks_kernel_knob(monkeypatch):
+    """With no explicit backend the candidate row resolves through
+    ops/backend.policy_backend: TTS_KERNEL_BACKEND=gpu on this CPU host
+    must pick the GPU chunk row (CI routes like a GPU host), while the
+    unset knob keeps the host platform's row."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        pytest.skip("suite running on a real TPU backend (TTS_TPU_TESTS=1)")
+    monkeypatch.delenv("TTS_KERNEL_BACKEND", raising=False)
+    assert cli.resolve_chunk_size(None, "pfsp", "device", "resident") == 50000
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "gpu")
+    assert cli.resolve_chunk_size(None, "pfsp", "device", "resident") == 49152
+    # Forced tpu off-TPU stays jnp-routed (policy_backend returns the
+    # physical platform), so the chunk row must NOT flip to 1024.
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "tpu")
+    assert cli.resolve_chunk_size(None, "pfsp", "device", "resident") == 50000
 
 
 def test_resolve_chunk_size_non_candidates_skip_backend_probe():

@@ -1,0 +1,288 @@
+"""The GPU (Triton) kernel lowering and the TTS_KERNEL_BACKEND seam.
+
+Correctness strategy (ops/backend.py): the GPU-flavored kernels — the
+factored tile bodies rebuilt under Triton's constraints (no scratch refs,
+no memory-space-pinned BlockSpecs, parallel CUDA-block grid) — run under
+Pallas INTERPRET mode on this CPU suite, bit-compared against the same jnp
+oracles the TPU kernels are gated on.  Interpret mode executes the kernel's
+real index/math structure, so parity here proves the lowering computes the
+same tree; `scripts/gpu_session.sh` stage 2 re-proves it compiled on a real
+card.  The seam itself is contract-pinned (`kernel-backend-inert`,
+`tts check`): off-GPU, every flavor but =gpu builds byte-identical programs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine.resident import resident_search
+from tpu_tree_search.engine.sequential import sequential_search
+from tpu_tree_search.ops import backend as BK
+from tpu_tree_search.ops import nqueens_device, pallas_kernels, pfsp_device
+from tpu_tree_search.problems import NQueensProblem, PFSPProblem
+from tpu_tree_search.problems.pfsp import taillard
+
+
+def _random_nodes(rng, jobs, B):
+    prmu = np.stack([rng.permutation(jobs).astype(np.int32)
+                     for _ in range(B)])
+    limit1 = rng.integers(-1, jobs - 1, B).astype(np.int32)
+    return jnp.asarray(prmu), jnp.asarray(limit1)
+
+
+# -- knob resolution --------------------------------------------------------
+
+def test_bad_knob_value_raises(monkeypatch):
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "cuda")
+    with pytest.raises(ValueError, match="TTS_KERNEL_BACKEND"):
+        BK.kernel_backend_mode()
+
+
+def test_resolution_table_on_cpu(monkeypatch):
+    """The `_auto_compact`-style policy on a non-GPU process: auto -> jnp
+    native; forced gpu -> non-native (interpret) but routes policy as gpu;
+    forced tpu off-TPU keeps jnp routing (policy stays the raw platform)."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("resolution golden assumes the CPU suite backend")
+    monkeypatch.delenv("TTS_KERNEL_BACKEND", raising=False)
+    assert BK.resolve_backend() == BK.Backend("jnp", True)
+    assert BK.kernel_kind() == "tpu" and BK.policy_backend() == "cpu"
+    assert BK.profile_backend() == "cpu"
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "gpu")
+    assert BK.resolve_backend() == BK.Backend("gpu", False)
+    assert BK.kernel_kind() == "gpu" and BK.policy_backend() == "gpu"
+    assert BK.profile_backend() == "cpu+gpu"  # compound: never a chip row
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "tpu")
+    assert BK.resolve_backend() == BK.Backend("tpu", False)
+    assert BK.kernel_kind() == "tpu" and BK.policy_backend() == "cpu"
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "jnp")
+    assert pallas_kernels.use_pallas() is False
+
+
+# -- kernel-level interpret bit-parity (the CI half of the GPU story) -------
+
+@pytest.mark.parametrize("bf16", [False, True])
+@pytest.mark.parametrize("inst,jobs,machines", [(14, 20, 10), (1, 12, 5)])
+def test_lb1_gpu_matches_oracle(inst, jobs, machines, bf16):
+    rng = np.random.default_rng(3)
+    if jobs == 20:
+        prob = PFSPProblem(inst=inst, lb="lb1", ub=1)
+    else:
+        ptm = taillard.reduced_instance(inst, jobs=jobs, machines=machines)
+        prob = PFSPProblem(lb="lb1", ub=0, p_times=ptm)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    pd, ld = _random_nodes(rng, jobs, 300)
+    oracle = pfsp_device._lb1_chunk(pd, ld, t.ptm_t, t.min_heads, t.min_tails)
+    got = pallas_kernels.pfsp_lb1_bounds(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        interpret=True, bf16=bf16, backend="gpu",
+    )
+    assert np.array_equal(np.asarray(oracle), np.asarray(got))
+
+
+@pytest.mark.parametrize("inst,jobs,machines", [(14, 20, 10), (1, 12, 5)])
+def test_lb1_d_gpu_matches_oracle(inst, jobs, machines):
+    rng = np.random.default_rng(5)
+    if jobs == 20:
+        prob = PFSPProblem(inst=inst, lb="lb1_d", ub=1)
+    else:
+        ptm = taillard.reduced_instance(inst, jobs=jobs, machines=machines)
+        prob = PFSPProblem(lb="lb1_d", ub=0, p_times=ptm)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    pd, ld = _random_nodes(rng, jobs, 300)
+    oracle = pfsp_device._lb1_d_chunk(pd, ld, t.ptm_t, t.min_heads,
+                                      t.min_tails)
+    got = pallas_kernels.pfsp_lb1_d_bounds(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        interpret=True, backend="gpu",
+    )
+    assert np.array_equal(np.asarray(oracle), np.asarray(got))
+
+
+@pytest.mark.parametrize("pair_group", [1, 4, None])
+@pytest.mark.parametrize("inst", [14, 21])
+def test_lb2_gpu_matches_oracle(inst, pair_group):
+    """lb2 under the gpu flavor across the pair-group unroll axis, on
+    ta014 (P=45) and ta021 (20x20, P=190 — where the auto policy
+    genuinely blocks).  Open child slots only: closed slots are garbage
+    by contract."""
+    rng = np.random.default_rng(7 + inst)
+    prob = PFSPProblem(inst=inst, lb="lb2", ub=1)
+    jobs = prob.jobs
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    pd, ld = _random_nodes(rng, jobs, 200)
+    oracle = pfsp_device._lb2_chunk(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    )
+    got = pallas_kernels.pfsp_lb2_bounds(
+        pd, ld, t, interpret=True, pair_group=pair_group, backend="gpu"
+    )
+    open_ = np.arange(jobs)[None, :] >= np.asarray(ld)[:, None] + 1
+    assert np.array_equal(np.asarray(oracle)[open_], np.asarray(got)[open_])
+
+
+def test_lb2_self_gpu_matches_chunk_with_gating():
+    rng = np.random.default_rng(23)
+    prob = PFSPProblem(inst=14, lb="lb2", ub=1)
+    t = pfsp_device.PFSPDeviceTables(prob.lb1_data, prob.lb2_data)
+    R = 600  # not a tile multiple: exercises padding
+    pd, ld = _random_nodes(rng, prob.jobs, R)
+    oracle = np.asarray(pfsp_device._lb2_self_chunk(
+        pd, ld, t.ptm_t, t.min_heads, t.min_tails,
+        t.pairs, t.lags, t.johnson_schedules,
+    ))
+    for n_active in (R, 97):
+        got = np.asarray(pallas_kernels.pfsp_lb2_self_bounds(
+            pd, ld, n_active, t, interpret=True, backend="gpu",
+        ))
+        assert np.array_equal(got[:n_active], oracle[:n_active])
+
+
+@pytest.mark.parametrize("g", [1, 3])
+@pytest.mark.parametrize("N", [9, 12])
+def test_nqueens_gpu_matches_oracle(N, g):
+    rng = np.random.default_rng(7)
+    B = 700  # not a tile multiple: exercises padding
+    boards = np.stack([rng.permutation(N).astype(np.uint8)
+                       for _ in range(B)])
+    depth = rng.integers(0, N + 1, B).astype(np.int32)
+    oracle = nqueens_device.make_core(N, g)(jnp.asarray(boards),
+                                            jnp.asarray(depth))
+    got = pallas_kernels.nqueens_labels(
+        jnp.asarray(boards), jnp.asarray(depth), N, g,
+        interpret=True, backend="gpu",
+    )
+    assert np.array_equal(np.asarray(oracle), np.asarray(got))
+
+
+# -- engine-level fuzz: forced-gpu searches land the sequential counts ------
+
+def _reduced_problem(lb: str):
+    ptm = taillard.reduced_instance(14, jobs=10, machines=5)
+    return PFSPProblem(lb=lb, ub=0, p_times=ptm)
+
+
+@pytest.mark.parametrize("compact", ["auto", "dense", "scatter"])
+@pytest.mark.parametrize("narrow", ["0", "auto"])
+def test_resident_gpu_lb1_matches_sequential(compact, narrow, monkeypatch):
+    """Full resident searches with the gpu flavor forced end to end:
+    TTS_KERNEL_BACKEND=gpu routes the policy tables through the gpu rows
+    (`policy_backend`) and — with TTS_PALLAS=force re-arming the demoted
+    lb1 family — runs the GPU-lowered kernels interpreted inside the real
+    engine, across the compact-mode and narrow-storage axes.  Counts must
+    land exactly on the sequential tier's."""
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "gpu")
+    monkeypatch.setenv("TTS_PALLAS", "force")
+    monkeypatch.setenv("TTS_COMPACT", compact)
+    monkeypatch.setenv("TTS_NARROW", narrow)
+    opt = sequential_search(_reduced_problem("lb1")).best
+    seq = sequential_search(_reduced_problem("lb1"), initial_best=opt)
+    res = resident_search(_reduced_problem("lb1"), m=4, M=64, K=8,
+                          initial_best=opt)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    assert res.best == opt
+    assert res.kernel_backend == "gpu"
+
+
+@pytest.mark.parametrize("pairblock", ["1", "auto"])
+def test_resident_gpu_lb2_matches_sequential(pairblock, monkeypatch):
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "gpu")
+    monkeypatch.setenv("TTS_LB2_PAIRBLOCK", pairblock)
+    opt = sequential_search(_reduced_problem("lb2")).best
+    seq = sequential_search(_reduced_problem("lb2"), initial_best=opt)
+    res = resident_search(_reduced_problem("lb2"), m=4, M=64, K=8,
+                          initial_best=opt)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+    assert res.best == opt
+
+
+def test_resident_gpu_nqueens_matches_sequential(monkeypatch):
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "gpu")
+    monkeypatch.setenv("TTS_PALLAS", "force")
+    seq = sequential_search(NQueensProblem(N=9))
+    res = resident_search(NQueensProblem(N=9), m=4, M=64, K=8)
+    assert (res.explored_tree, res.explored_sol) == (
+        seq.explored_tree, seq.explored_sol
+    )
+
+
+# -- the cache seam: a knob flip rebuilds, a flip back hits -----------------
+
+def test_knob_flip_rebuilds_program_and_flip_back_hits(monkeypatch):
+    """The raw knob + resolved kind ride routing_cache_token, so =gpu must
+    build a DISTINCT resident program from the unset build, and restoring
+    the knob must hit the original cached program (same object — the
+    token round-trips)."""
+    import jax
+
+    from tpu_tree_search.engine.resident import _make_program, resolve_capacity
+
+    prob = _reduced_problem("lb1")
+    monkeypatch.delenv("TTS_KERNEL_BACKEND", raising=False)
+    tok0 = pfsp_device.routing_cache_token(prob)
+    capacity, M = resolve_capacity(prob, 64, None)
+    dev = jax.devices()[0]
+    p0 = _make_program(prob, 4, M, 8, capacity, dev)
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "gpu")
+    assert pfsp_device.routing_cache_token(prob) != tok0
+    p_gpu = _make_program(prob, 4, M, 8, capacity, dev)
+    assert p_gpu is not p0
+    monkeypatch.delenv("TTS_KERNEL_BACKEND", raising=False)
+    assert pfsp_device.routing_cache_token(prob) == tok0
+    assert _make_program(prob, 4, M, 8, capacity, dev) is p0
+
+
+# -- reporting: the banner and --json carry the resolved flavor -------------
+
+def test_cli_json_records_backend_and_refusal(capsys, monkeypatch):
+    """Under the forced gpu flavor on a non-GPU host the --json record
+    must carry kernel_backend + kernel_backend_mode, and the megakernel
+    resolver's refusal must name the real reason (gpu kernels are not
+    native here), not the generic not-on-TPU line."""
+    import json
+
+    from tpu_tree_search import cli
+
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "gpu")
+    assert cli.main(["nqueens", "--N", "6", "--tier", "device",
+                     "--engine", "resident", "--m", "4", "--M", "64",
+                     "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["kernel_backend"] == "gpu"
+    assert rec["kernel_backend_mode"] == "gpu"
+    assert "not native here" in rec["megakernel_reason"]
+
+
+def test_cli_banner_names_forced_backend(capsys, monkeypatch):
+    monkeypatch.setenv("TTS_KERNEL_BACKEND", "gpu")
+    from tpu_tree_search import cli
+
+    assert cli.main(["nqueens", "--N", "6", "--tier", "device",
+                     "--engine", "resident", "--m", "4", "--M", "64"]) == 0
+    out = capsys.readouterr().out
+    assert "Kernel backend: gpu (forced: gpu)" in out
+
+
+def test_cli_json_default_backend_unforced(capsys, monkeypatch):
+    """Unset knob: the record reports the auto-resolved flavor and omits
+    kernel_backend_mode (no forced spelling to preserve)."""
+    import json
+
+    from tpu_tree_search import cli
+
+    monkeypatch.delenv("TTS_KERNEL_BACKEND", raising=False)
+    assert cli.main(["nqueens", "--N", "6", "--tier", "device",
+                     "--engine", "resident", "--m", "4", "--M", "64",
+                     "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["kernel_backend"] == "tpu"  # the flavor of record off-GPU
+    assert "kernel_backend_mode" not in rec

@@ -303,7 +303,7 @@ def test_auto_window_arms_tiled_past_limit(monkeypatch):
     the window the original single-tile form is kept verbatim.  The TPU
     backend gate is patched on — this is a decision-policy fact, not an
     execution one."""
-    monkeypatch.setattr(MK, "_on_tpu", lambda device=None: True)
+    monkeypatch.setattr(MK, "_native_kind", lambda device=None: "tpu")
     prob = NQueensProblem(N=8)
     n = int(prob.child_slots)
     small = MK.resolve(prob, 64)

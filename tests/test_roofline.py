@@ -69,6 +69,28 @@ def test_peak_resolution_order(monkeypatch):
         RL.hbm_gbps_override()
 
 
+def test_peak_gpu_row_and_compound_key_fallback(monkeypatch):
+    """The gpu nominal row (900 GB/s, the documented A100-PCIe-class
+    placeholder) resolves for native-gpu profile keys; a forced non-native
+    flavor's compound "platform+kind" key misses the table and falls
+    through to the honest cpu row — an interpret run must never report
+    itself against chip-class bandwidth. The override + measured-fit
+    orders beat nominal on gpu exactly as on tpu."""
+    monkeypatch.delenv("TTS_HBM_GBPS", raising=False)
+    bps, src = RL.peak_bytes_per_sec("gpu")
+    assert (bps, src) == (RL.NOMINAL_GBPS["gpu"] * 1e9, "nominal:gpu")
+    assert RL.NOMINAL_GBPS["gpu"] == 900.0
+    # profile_backend's compound key for a forced non-native flavor
+    bps, src = RL.peak_bytes_per_sec("cpu+gpu")
+    assert (bps, src) == (RL.NOMINAL_GBPS["cpu"] * 1e9, "nominal:cpu+gpu")
+    entry = {"backend": "gpu", "links": {"hbm": {"per_sec": 3350e9}}}
+    bps, src = RL.peak_bytes_per_sec("gpu", entry)
+    assert (bps, src) == (3350e9, "costmodel:hbm")
+    monkeypatch.setenv("TTS_HBM_GBPS", "1008")
+    bps, src = RL.peak_bytes_per_sec("gpu", entry)
+    assert (bps, src) == (1008e9, "env:TTS_HBM_GBPS")
+
+
 def test_hbm_entry_picks_backend_match():
     prof = {
         "tpu|device-D1|x": {"backend": "tpu",

@@ -56,7 +56,7 @@ KNOBS = (
     "TTS_LB2_STAGED", "TTS_XLA_TRACE", "TTS_FLIGHTREC", "TTS_COSTMODEL",
     "TTS_QUALITY", "TTS_MEGAKERNEL", "TTS_MEGAKERNEL_MT", "TTS_STEAL",
     "TTS_PODS", "TTS_SIM_LAT_ICI", "TTS_SIM_LAT_DCN", "TTS_NARROW",
-    "TTS_HBM_GBPS",
+    "TTS_HBM_GBPS", "TTS_KERNEL_BACKEND", "TTS_PALLAS_GPU_MB",
 )
 
 #: Matrix axes (the lb2 families add the pair-block axis).
@@ -73,7 +73,7 @@ def load_contracts() -> dict:
     and return the registry."""
     from ..engine import batched, pipeline, resident  # noqa: F401
     from ..obs import counters, phases, quality  # noqa: F401
-    from ..ops import compaction, megakernel, pfsp_device  # noqa: F401
+    from ..ops import backend, compaction, megakernel, pfsp_device  # noqa: F401
     from ..parallel import topology  # noqa: F401
     from . import guard, lockorder  # noqa: F401
 
@@ -418,6 +418,14 @@ VARIANT_ENVS = {
     "steal-flat": {"TTS_STEAL": "flat"},
     "steal-hier": {"TTS_STEAL": "hier", "TTS_PODS": "2"},
     "narrow0": {"TTS_NARROW": "0"},
+    # Kernel-backend seam (ops/backend.py): auto/jnp/tpu must stay
+    # byte-identical to "off" on this non-GPU audit host; gpu may change
+    # the program body but never the step's carry signature
+    # (kernel-backend-inert).
+    "kb-auto": {"TTS_KERNEL_BACKEND": "auto"},
+    "kb-jnp": {"TTS_KERNEL_BACKEND": "jnp"},
+    "kb-tpu": {"TTS_KERNEL_BACKEND": "tpu"},
+    "kb-gpu": {"TTS_KERNEL_BACKEND": "gpu"},
 }
 
 
@@ -516,6 +524,13 @@ def cache_key_artifact(family: str) -> CacheKeyArtifact:
         "TTS_NARROW": (
             build({**base, "TTS_NARROW": "auto"}),
             build({**base, "TTS_NARROW": "0"}),
+        ),
+        # The kernel-backend flavor rides the routing token (raw knob +
+        # resolved kind), so a flip to the gpu flavor must rebuild — a
+        # stale auto program under =gpu would run the wrong kernel body.
+        "TTS_KERNEL_BACKEND": (
+            p0,
+            build({**base, "TTS_KERNEL_BACKEND": "gpu"}),
         ),
     }
     if family == "pfsp-lb2":

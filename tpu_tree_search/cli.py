@@ -565,10 +565,16 @@ def resolve_chunk_size(M, problem_name: str, tier: str, engine: str,
     ~linear in M while PFSP frontiers rarely fill large chunks, so
     small-but-full chunks run ~1.3x (lb1) to ~3x (staged lb2) faster:
     PFSP device tier + resident engine on TPU defaults to 1024.
+    The gpu backend gets its own explicit row, 49152: the reference's GPU
+    offload sizing is the same ``M = 50000`` pool chunk (the published
+    PFSP-on-GPU runs saturate the device with a single ~50k-node offload
+    per cycle — arXiv 2012.09511 §IV), rounded DOWN to a multiple of 8 so
+    the resident pool keeps the sublane-quantum alignment the megakernel
+    and tiled compaction gates require (50000 % 8 == 2 would refuse them).
     Everything else — explicit ``--M``, the offload engine (each chunk
     pays a ~360ms host round trip; small chunks would multiply them),
-    non-TPU backends (unmeasured), N-Queens (wide frontiers fill big
-    chunks), and the sharded tiers (M is per shard) — keeps the
+    remaining non-TPU backends (unmeasured), N-Queens (wide frontiers fill
+    big chunks), and the sharded tiers (M is per shard) — keeps the
     reference's 50000 (the per-program ``config const M = 50000`` of each
     GPU main, `pfsp_gpu_chpl.chpl:24` / `nqueens_gpu_chpl.chpl:21`; it is
     not defined in `util.chpl`). The candidate combination is
@@ -581,12 +587,16 @@ def resolve_chunk_size(M, problem_name: str, tier: str, engine: str,
         return 50000
     if backend is None:
         try:
-            import jax
+            from .ops import backend as BK
 
-            backend = jax.default_backend()
+            backend = BK.policy_backend()
         except Exception:
             backend = "cpu"
-    return 1024 if backend == "tpu" else 50000
+    if backend == "tpu":
+        return 1024
+    if backend == "gpu":
+        return 49152
+    return 50000
 
 
 def uses_compaction(args) -> bool:
@@ -820,6 +830,14 @@ def print_results(args, problem, res) -> None:
     if res.compact:
         tag = " (auto)" if res.compact_auto else ""
         print(f"Survivor path: {res.compact}{tag}")
+    if res.kernel_backend:
+        # The resolved kernel flavor (TTS_KERNEL_BACKEND, ops/backend.py),
+        # with the raw knob when it forced a non-default resolution.
+        from .ops import backend as _BK
+
+        mode = _BK.kernel_backend_mode()
+        tag = "" if mode == "auto" else f" (forced: {mode})"
+        print(f"Kernel backend: {res.kernel_backend}{tag}")
     if res.megakernel:
         tag = " (auto)" if res.megakernel_auto else ""
         why = f" — {res.megakernel_reason}" if res.megakernel_reason else ""
@@ -964,6 +982,15 @@ def result_record(args, res) -> dict:
             # "compact") — a stats line must prove whether the fused cycle
             # or the op-chain produced the number, and a refusal must say
             # why it fell back.
+            # The resolved kernel flavor (TTS_KERNEL_BACKEND seam) — a
+            # stats line must prove which lowering produced the number,
+            # and the raw knob when it forced the resolution.
+            if res.kernel_backend is not None:
+                rec["kernel_backend"] = res.kernel_backend
+                from .ops import backend as _BK
+
+                if _BK.kernel_backend_mode() != "auto":
+                    rec["kernel_backend_mode"] = _BK.kernel_backend_mode()
             if res.megakernel is not None:
                 rec["megakernel"] = res.megakernel
                 if res.megakernel_auto:

@@ -93,9 +93,13 @@ def resolve_target_band(
     if not profile:
         return default, None
     try:
-        import jax
+        # The kernel-backend seam's profile key: the raw platform under
+        # auto (byte-stable with every banked profile), a compound
+        # "platform+kind" for a forced non-native flavor so its bands
+        # never contaminate the native rows (ops/backend.profile_backend).
+        from ..ops import backend as BK
 
-        backend = jax.default_backend()
+        backend = BK.profile_backend()
     except Exception:  # noqa: BLE001 — band resolution must never fail a run
         backend = "cpu"
     hit = cm.lookup(profile, backend, topology, cm.shape_class(problem))

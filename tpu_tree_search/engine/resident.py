@@ -167,6 +167,14 @@ class _ResidentProgram:
 
         self.megakernel = MK.resolve(problem, M, self.device,
                                      mp_axis=mp_axis, mp_size=mp_size)
+        # Kernel-backend seam (TTS_KERNEL_BACKEND, ops/backend.py): which
+        # kernel flavor this build routed ('gpu' only when the seam
+        # resolves gpu — everything else keeps the TPU flavor of record).
+        # Surfaced through SearchResult.kernel_backend; the raw knob and
+        # the resolved kind both ride routing_cache_token.
+        from ..ops import backend as _BK
+
+        self.kernel_backend = _BK.kernel_kind(self.device)
         self._step = self._build()
 
     def loop_fns(self, K: int | None = None):
@@ -1032,6 +1040,7 @@ def resident_search(
                 megakernel_reason=program.megakernel.reason,
                 megakernel_mt=program.megakernel.mt or None,
                 megakernel_tiled=program.megakernel.tiled,
+                kernel_backend=program.kernel_backend,
                 pipeline_depth=depth,
                 k_resolved=program.K,
                 k_auto=k_auto,
@@ -1130,6 +1139,7 @@ def resident_search(
         megakernel_reason=program.megakernel.reason,
         megakernel_mt=program.megakernel.mt or None,
         megakernel_tiled=program.megakernel.tiled,
+        kernel_backend=program.kernel_backend,
         pipeline_depth=depth,
         k_resolved=program.K,
         k_auto=k_auto,

@@ -87,6 +87,12 @@ class SearchResult:
     # pool-resident form). None/False when the kernel is off.
     megakernel_mt: int | None = None
     megakernel_tiled: bool = False
+    # Resident tiers: the kernel flavor the backend seam resolved for this
+    # build (TTS_KERNEL_BACKEND, ops/backend.py) — "tpu" (the flavor of
+    # record, including jnp-routed and interpret-forced builds) or "gpu"
+    # (the Triton-structured lowering). None for tiers without a resident
+    # program.
+    kernel_backend: str | None = None
     # Roofline audit (obs/roofline.py): per-phase %-of-memory-bound-peak
     # computed from the phase_profile ns splits, the analytic per-cycle
     # byte floors, and the resolved peak HBM bandwidth (COSTMODEL "hbm"

@@ -42,9 +42,26 @@ import os
 
 from . import phases as obs_phases
 
-#: Nominal peak HBM bandwidth per backend, GB/s — the documented fallback
-#: when no measured ``hbm`` link fit exists (v5e-class chip for tpu; a
-#: desktop-class DDR figure for cpu so interpret-mode tables stay finite).
+#: Nominal peak HBM bandwidth per backend, GB/s — the LAST-RESORT fallback
+#: when neither ``TTS_HBM_GBPS`` nor a measured COSTMODEL ``hbm`` link fit
+#: is available (`peak_bytes_per_sec` resolves in that order on every
+#: backend, gpu included).  Sources:
+#:
+#:   * ``tpu``  819.0 — TPU v5e datasheet HBM2 bandwidth (the chip class
+#:     the hardware sessions target; a v4 is 1228, overridable).
+#:   * ``gpu``  900.0 — A100-40GB PCIe class datasheet HBM2e figure,
+#:     rounded down; a PLACEHOLDER for whatever card actually runs
+#:     `scripts/gpu_session.sh`, which banks the measured figure into
+#:     GPU_BASELINE.json and COSTMODEL (an H100 SXM is ~3350, a consumer
+#:     4090 ~1008 — always prefer ``TTS_HBM_GBPS`` or a measured fit on
+#:     gpu; ``nominal:gpu`` in ``peak_source`` flags an unmeasured run).
+#:   * ``cpu``  40.0 — dual-channel DDR4-3200 (25.6) plus margin, so
+#:     interpret-mode tables stay finite and obviously non-chip.
+#:
+#: Keys are raw platforms; forced non-native flavors resolve a compound
+#: "platform+kind" profile key (ops/backend.profile_backend) which misses
+#: this table and falls through to the cpu row — interpret runs never
+#: masquerade as chip-speed rows.
 NOMINAL_GBPS = {"tpu": 819.0, "gpu": 900.0, "cpu": 40.0}
 
 #: The cycle phases the audit rows cover (obs/phases.py CYCLE_SLOTS).
@@ -190,16 +207,11 @@ def meta_args(program) -> dict:
     import numpy as np
 
     try:
-        backend = getattr(program.device, "platform", None)
-    except Exception:
-        backend = None
-    if not backend:
-        try:
-            import jax
+        from ..ops import backend as BK
 
-            backend = jax.default_backend()
-        except Exception:
-            backend = "cpu"
+        backend = BK.profile_backend(getattr(program, "device", None))
+    except Exception:
+        backend = "cpu"
     vals_dt = program.pool_fields[0][1]
     aux_dt = program.pool_fields[1][1]
     return {

@@ -1,0 +1,182 @@
+"""Kernel-backend seam: which flavor of the Pallas kernel layer a build
+targets, resolved once per program build and keyed into every cache.
+
+The evaluators exist in three flavors:
+
+  * ``tpu`` — the Mosaic-TPU kernels of `ops/pallas_kernels.py` /
+    `ops/megakernel.py` (VMEM/SMEM BlockSpecs, scratch refs,
+    ``dimension_semantics``, the scoped-VMEM charge).
+  * ``gpu`` — the same tile bodies lowered through
+    ``jax.experimental.pallas.triton``: plain BlockSpecs (Triton has no
+    memory spaces and **no scratch memory**, so the position-major scan
+    staging unrolls statically instead — see
+    `pallas_kernels._front_scan`), Triton compiler params, and a
+    parallel-CUDA-block grid.  Tiled megakernel streaming is refused (its
+    cross-tile SMEM carry needs the TPU's sequential grid).
+  * ``jnp`` — the fused XLA oracles (`ops/pfsp_device.py`,
+    `ops/nqueens_device.py`); the portable path and the semantic oracle
+    every kernel is bit-compared against.
+
+``TTS_KERNEL_BACKEND=auto|tpu|gpu|jnp`` picks one, resolved
+`_auto_compact`-style: ``auto`` (the default) maps the target device's
+platform — TPU -> ``tpu``, GPU/CUDA/ROCm -> ``gpu``, anything else ->
+``jnp`` — so an unset knob on a non-GPU process builds byte-identical
+jaxprs to a build that predates this module (contract
+`kernel-backend-inert`).  A forced flavor that does not match the physical
+platform still builds (``gpu`` runs the Triton-structured kernels under
+Pallas interpret mode — the CI parity path; ``tpu`` off-TPU keeps the jnp
+routing exactly as ``TTS_PALLAS`` always has).  The raw knob and the
+resolved kind both ride ``routing_cache_token``, so a flip rebuilds the
+resident program instead of reusing a stale flavor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+KINDS = ("tpu", "gpu", "jnp")
+
+#: platform strings that count as a GPU target (jax reports "gpu" for the
+#: plugin backends; raw PJRT device platforms spell the vendor).
+_GPU_PLATFORMS = ("gpu", "cuda", "rocm")
+
+
+def kernel_backend_mode() -> str:
+    """The raw ``TTS_KERNEL_BACKEND`` knob: ``auto`` (default) or one of
+    ``KINDS``.  Baked into compiled programs at trace time, so the engines
+    carry it in ``routing_cache_token``."""
+    mode = os.environ.get("TTS_KERNEL_BACKEND", "auto")
+    if mode != "auto" and mode not in KINDS:
+        raise ValueError(
+            "TTS_KERNEL_BACKEND must be 'auto', 'tpu', 'gpu', or 'jnp', "
+            f"got {mode!r}"
+        )
+    return mode
+
+
+@dataclasses.dataclass(frozen=True)
+class Backend:
+    """The resolved kernel backend for one program build.
+
+    ``kind``: which kernel flavor to build (one of ``KINDS``).
+    ``native``: the physical platform can compile that flavor for real —
+    False means the kernels run under Pallas interpret mode (the
+    correctness/CI path; ``jnp`` is native everywhere)."""
+
+    kind: str
+    native: bool
+
+
+def _platform(device=None) -> str:
+    """The physical platform of the target device (the same fallback
+    ladder `use_pallas`/`resolve_compact_mode` always used: an explicit
+    device wins, else the process default backend)."""
+    if device is not None:
+        return getattr(device, "platform", "cpu") or "cpu"
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def resolve_backend(device=None) -> Backend:
+    """Resolve the ``TTS_KERNEL_BACKEND`` knob against the target device —
+    the `_auto_compact`-style policy this module exists for."""
+    mode = kernel_backend_mode()
+    plat = _platform(device)
+    if mode == "auto":
+        if plat == "tpu":
+            return Backend("tpu", True)
+        if plat in _GPU_PLATFORMS:
+            return Backend("gpu", True)
+        return Backend("jnp", True)
+    if mode == "jnp":
+        return Backend("jnp", True)
+    if mode == "gpu":
+        return Backend("gpu", plat in _GPU_PLATFORMS)
+    return Backend("tpu", plat == "tpu")
+
+
+def kernel_kind(device=None) -> str:
+    """The kernel FLAVOR a pallas entry builds: ``gpu`` only when the
+    resolved backend is gpu.  Everything else — including a ``jnp`` kind
+    reached by forced interpret mode (``TTS_PALLAS_INTERPRET=1`` routes to
+    the kernels on any backend) — keeps the TPU-flavored kernels, the
+    interpret-mode flavor of record, so pre-existing builds stay
+    byte-identical."""
+    return "gpu" if resolve_backend(device).kind == "gpu" else "tpu"
+
+
+def policy_backend(device=None) -> str:
+    """The backend string the ``_auto_*`` policy tables key on.
+
+    ``gpu`` whenever the resolved kind is gpu — forced gpu on a CPU
+    process exercises the gpu policy rows too, so CI parity runs route
+    exactly like a GPU host.  ``tpu`` only when NATIVE: a forced ``tpu``
+    off-TPU falls back to jnp routing (`use_pallas` is False there), so
+    its policy rows must stay the physical platform's — that keeps the
+    kb-tpu build byte-identical off-GPU (contract `kernel-backend-inert`).
+    The ``jnp`` kind runs XLA on whatever hardware is actually there, so
+    its rows are the platform's as well."""
+    b = resolve_backend(device)
+    if b.kind == "gpu":
+        return "gpu"
+    if b.kind == "tpu" and b.native:
+        return "tpu"
+    return _platform(device)
+
+
+def profile_backend(device=None) -> str:
+    """The backend component of COSTMODEL profile keys and roofline peaks
+    (`obs/costmodel.profile_key` — ``backend|topology|shape``).  Under
+    ``auto`` (and any forced flavor that matches the platform) this is the
+    raw platform string — byte-stable with every profile banked before
+    this module existed.  A forced NON-native flavor gets a compound
+    ``platform+kind`` key so its dispatch fits and band tables never
+    contaminate the native profiles."""
+    b = resolve_backend(device)
+    plat = _platform(device)
+    native_name = (
+        b.kind == plat
+        or (b.kind == "gpu" and plat in _GPU_PLATFORMS)
+        or (b.kind == "jnp" and plat not in ("tpu",) + _GPU_PLATFORMS)
+    )
+    if kernel_backend_mode() == "auto" or native_name:
+        return plat
+    return f"{plat}+{b.kind}"
+
+
+# -- compiled-program contracts (`tts check`, analysis/contracts.py) --------
+
+from ..analysis.contracts import contract  # noqa: E402
+
+
+@contract(
+    "kernel-backend-inert",
+    claim="TTS_KERNEL_BACKEND unset, =auto, =jnp, and =tpu all build "
+          "byte-identical resident step jaxprs on a non-GPU process — the "
+          "backend seam resolves to the same flavor today's builds already "
+          "had, adds zero behavior of its own off-GPU, and only =gpu "
+          "changes the program (the Triton-structured interpret lowering)",
+    artifact="variants",
+)
+def _contract_kernel_backend_inert(art, cell):
+    inert = [lb for lb in ("kb-auto", "kb-jnp", "kb-tpu") if art.has(lb)]
+    if not art.has("off") or not inert:
+        return []  # variant set traced without the kernel-backend labels
+    out = []
+    for lb in inert:
+        if art.text(lb) != art.text("off"):
+            out.append(
+                f"TTS_KERNEL_BACKEND={lb[3:]} build differs from the unset "
+                "build on a non-GPU process (the seam must be inert off-GPU)"
+            )
+    if art.has("kb-gpu") and art.outvars("kb-gpu") != art.outvars("off"):
+        out.append(
+            "TTS_KERNEL_BACKEND=gpu changed the resident step carry width "
+            "(the flavor may change the program body, never its signature)"
+        )
+    return out

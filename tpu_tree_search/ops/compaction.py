@@ -77,8 +77,16 @@ def _auto_compact(problem, M: int | None, n: int | None, platform: str) -> str:
         on the full M*n grid, and the shift compaction's cost is flat in
         the survivor count -> ``dense`` (every backend: the CPU tiers only
         see test-sized chunks).
-      * non-TPU backends: ``scatter`` is a fast gather-like op on CPU and
-        sort LOSES ~2x (the original measured default) -> unchanged.
+      * gpu kernel backend: small grids take the same log-shift ``dense``
+        as TPU (the shift passes are coalesced row copies, the regime the
+        reference's prefix-sum compaction runs in — arXiv 2012.09511);
+        larger grids fall back to ``scatter``, which on CUDA is a real
+        parallel scatter rather than the TPU's serialized one.
+        PROVISIONAL until `bench.py pick_compact` rows land from a GPU
+        session (scripts/gpu_session.sh stage 4).
+      * other non-TPU backends: ``scatter`` is a fast gather-like op on
+        CPU and sort LOSES ~2x (the original measured default)
+        -> unchanged.
       * TPU, small grids (M*n <= 64k — the tuned PFSP M=1024 class): the
         log-shift passes are near-free and dodge the serialized scatter
         -> ``dense``.
@@ -87,9 +95,17 @@ def _auto_compact(problem, M: int | None, n: int | None, platform: str) -> str:
       * TPU, large pruned grids: survivors are sparse, so the
         S-proportional binary-search inverse does the least work
         -> ``search``.
+
+    ``platform`` is the policy backend (`ops/backend.policy_backend`): the
+    resolved kernel backend when it names real hardware, else the physical
+    platform — so TTS_KERNEL_BACKEND=gpu exercises the gpu rows anywhere.
     """
     if getattr(problem, "name", None) == "nqueens":
         return "dense"
+    if platform == "gpu":
+        if M is not None and n is not None and M * n <= (1 << 16):
+            return "dense"
+        return "scatter"
     if platform != "tpu":
         return "scatter"
     if M is not None and n is not None and M * n <= (1 << 16):
@@ -111,16 +127,9 @@ def resolve_compact_mode(problem=None, M: int | None = None,
     mode = compact_mode()
     if mode != "auto":
         return mode
-    if device is not None:
-        platform = getattr(device, "platform", "cpu")
-    else:
-        try:
-            import jax
+    from . import backend as BK
 
-            platform = jax.default_backend()
-        except Exception:
-            platform = "cpu"
-    return _auto_compact(problem, M, n, platform)
+    return _auto_compact(problem, M, n, BK.policy_backend(device))
 
 
 def _shift_left(x, s: int):

@@ -90,6 +90,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..analysis.contracts import contract
+from . import backend as BK
 from . import pallas_kernels as PK
 
 #: single-tile window on the Mt*n product — within it the original
@@ -161,7 +162,10 @@ class Decision:
     `tts` banner and carried in SearchResult.megakernel_reason.
     ``mt``/``grid`` record the resolved pool-tile width and tile count
     (``grid == 1`` is the original single-tile resident form; ``grid > 1``
-    streams the pool through VMEM tile by tile)."""
+    streams the pool through VMEM tile by tile).  ``backend`` is the
+    kernel flavor the cycle builds with (`ops/backend.py` — ``gpu`` is
+    the Triton lowering, single-tile only: the cross-tile SMEM carry
+    needs the TPU's sequential grid)."""
 
     enabled: bool
     auto: bool
@@ -169,6 +173,7 @@ class Decision:
     reason: str | None
     mt: int = 0
     grid: int = 1
+    backend: str = "tpu"
 
     @property
     def state(self) -> str:
@@ -188,13 +193,16 @@ def _family(problem) -> str | None:
     return None
 
 
-def _on_tpu(device) -> bool:
+def _native_kind(device) -> str | None:
+    """The kernel flavor the resolved backend compiles NATIVELY on the
+    target device — 'tpu' or 'gpu' — else None (interpret territory:
+    forced/interpret builds, CPU processes).  Replaces the old hard
+    ``platform == "tpu"`` gate with the `ops/backend.py` seam."""
     try:
-        if device is not None:
-            return getattr(device, "platform", None) == "tpu"
-        return jax.default_backend() == "tpu"
+        b = BK.resolve_backend(device)
     except Exception:
-        return False
+        return None
+    return b.kind if (b.native and b.kind in ("tpu", "gpu")) else None
 
 
 def _mega_pool_bytes(M: int, n: int, pool_itemsize: int = 4,
@@ -260,11 +268,12 @@ def _tile_window_ok(fam: str, mt: int, n: int) -> bool:
     return mt * n <= SMALL_M_LIMIT
 
 
-def _fits(problem, fam: str, M: int, n: int,
-          mt: int | None = None) -> tuple[bool, str | None]:
-    """VMEM feasibility at pool-tile width ``mt`` (None or M — the
+def _fits(problem, fam: str, M: int, n: int, mt: int | None = None,
+          backend: str = "tpu") -> tuple[bool, str | None]:
+    """Fast-memory feasibility at pool-tile width ``mt`` (None or M — the
     single-tile resident form; smaller — the streamed per-tile +
-    double-buffer + stash charge of `_mega_pool_bytes`)."""
+    double-buffer + stash charge of `_mega_pool_bytes`), against the
+    backend's budget (`pallas_kernels._vmem_limit_bytes`)."""
     from ..problems.base import narrow_enabled
 
     if mt is not None and mt >= M:
@@ -287,7 +296,7 @@ def _fits(problem, fam: str, M: int, n: int,
             t, n, m, extra + PK._lb2_static_extra(n, m, P + (-P) % pg), 3,
             pair_copies=5, pair_group=pg,
         )
-    budget = PK._vmem_budget()
+    budget = PK._vmem_budget(backend)
     if need > budget:
         if mt is None:
             return False, (
@@ -327,26 +336,30 @@ def resolve(problem, M: int, device=None, mp_axis: str | None = None,
     """Resolve the megakernel routing for one resident program build —
     the `_auto_compact`-style policy.  Correctness refusals (unsupported
     bound family, mp pair sharding, the lb2 bf16-exactness gate, tile
-    misalignment — including a TTS_MEGAKERNEL_MT that does not divide M)
-    hold even under ``force``; the remaining gates (real TPU, per-tile
-    VMEM fit) apply to ``auto`` only."""
+    misalignment — including a TTS_MEGAKERNEL_MT that does not divide M,
+    and tiled streaming on the gpu flavor, whose cross-tile SMEM carry
+    only the TPU's sequential grid can run) hold even under ``force``;
+    the remaining gates (native TPU/GPU backend, per-tile memory fit)
+    apply to ``auto`` only."""
     mode = megakernel_mode()
     if mode == "0":
         return Decision(False, False, False, None)
     auto = mode == "auto"
     fam = _family(problem)
     n = int(problem.child_slots)
+    kb = BK.kernel_kind(device)  # 'gpu' only when the seam resolves gpu
     if fam not in ("nqueens", "lb1", "lb2"):
         return Decision(False, auto, False,
                         f"unsupported bound family {fam!r} (the megakernel "
-                        "ports nqueens/lb1/lb2 only)")
+                        "ports nqueens/lb1/lb2 only)", backend=kb)
     if mp_axis is not None or mp_size > 1:
         return Decision(False, auto, False,
                         "mp pair-axis sharding (the fused cycle is "
-                        "single-shard)")
+                        "single-shard)", backend=kb)
     if M % 8 != 0:
         return Decision(False, auto, False,
-                        f"M={M} not a multiple of the sublane quantum (8)")
+                        f"M={M} not a multiple of the sublane quantum (8)",
+                        backend=kb)
     if fam == "lb2":
         t = problem.device_tables()
         if not getattr(t, "exact_bf16", False):
@@ -354,18 +367,42 @@ def resolve(problem, M: int, device=None, mp_axis: str | None = None,
                             "lb2 bf16-exactness gate: max processing time "
                             ">= 256, the max-plus MXU formulation is not "
                             "bit-exact (f32 pair-blocked oracle keeps the "
-                            "cycle)")
+                            "cycle)", backend=kb)
     mt_env = megakernel_mt()
     if mt_env is not None and (mt_env % 8 != 0 or M % mt_env != 0):
         return Decision(False, auto, False,
                         f"TTS_MEGAKERNEL_MT={mt_env} must be a multiple of "
-                        f"the sublane quantum (8) and divide M={M}")
+                        f"the sublane quantum (8) and divide M={M}",
+                        backend=kb)
+    if kb == "gpu" and mt_env is not None and mt_env < M:
+        return Decision(False, auto, False,
+                        f"gpu backend: TTS_MEGAKERNEL_MT={mt_env} < M={M} "
+                        "requests tiled streaming, whose cross-tile SMEM "
+                        "carry needs the TPU's sequential grid (Triton "
+                        "blocks are parallel)", backend=kb)
+    native = _native_kind(device)
     if not auto:
-        interpret = PK.pallas_interpret() or not _on_tpu(device)
+        interpret = PK.pallas_interpret() or native is None
+        if kb == "gpu":
+            return Decision(True, False, interpret, None, mt=M, grid=1,
+                            backend="gpu")
         mt = mt_env or _resolve_mt(problem, fam, M, n) or M
         return Decision(True, False, interpret, None, mt=mt, grid=M // mt)
-    if not _on_tpu(device) or PK.pallas_interpret():
-        return Decision(False, True, False, "auto: not on a TPU backend")
+    if native is None or PK.pallas_interpret():
+        reason = ("auto: not on a TPU backend" if kb != "gpu" else
+                  "auto: kernel backend gpu is not native here (no GPU in "
+                  "this process — TTS_MEGAKERNEL=force runs it interpreted)")
+        return Decision(False, True, False, reason, backend=kb)
+    if kb == "gpu":
+        # Single tile or nothing: tiled streaming is a TPU-only construct.
+        ok, why = _fits(problem, fam, M, n, backend="gpu")
+        if _tile_window_ok(fam, M, n) and ok:
+            return Decision(True, True, False, None, mt=M, grid=1,
+                            backend="gpu")
+        why = why or (
+            f"gpu backend: M*n={M * n} exceeds the single-tile window and "
+            "tiled streaming needs the TPU's sequential-grid SMEM carry")
+        return Decision(False, True, False, why, backend="gpu")
     if mt_env is not None:
         ok, why = _fits(problem, fam, M, n, mt_env)
         if not ok:
@@ -571,8 +608,38 @@ def _mega_lb2_kernel(prmu_ref, limit1_ref, valid_ref, best_ref,
     scal_ref[:] = _scalar_lanes(tree_inc, sol_inc, best)
 
 
+def _mega_lb1_kernel_gpu(prmu_ref, limit1_ref, valid_ref, best_ref,
+                         ptm_ref, heads_ref, tails_ref,
+                         out_vals_ref, out_aux_ref, scal_ref,
+                         *, n: int, m: int, M: int, bf16: bool):
+    """The lb1 cycle without its scan scratch — the Triton flavor
+    (`pallas_kernels._front_scan` unrolls statically where the TPU kernel
+    staged; the epilogue is shift/select math, backend-neutral)."""
+    _mega_lb1_kernel(prmu_ref, limit1_ref, valid_ref, best_ref,
+                     ptm_ref, heads_ref, tails_ref,
+                     out_vals_ref, out_aux_ref, scal_ref, None,
+                     n=n, m=m, M=M, bf16=bf16)
+
+
+def _mega_lb2_kernel_gpu(prmu_ref, limit1_ref, valid_ref, best_ref,
+                         ptm_ref, heads_ref,
+                         p0_ref, p1_ref, lag_ref, t0_ref, t1_ref,
+                         msel0_ref, msel1_ref, jorder_ref,
+                         out_vals_ref, out_aux_ref, scal_ref,
+                         *, n: int, m: int, P: int, M: int, pg: int,
+                         bf16: bool):
+    _mega_lb2_kernel(prmu_ref, limit1_ref, valid_ref, best_ref,
+                     ptm_ref, heads_ref,
+                     p0_ref, p1_ref, lag_ref, t0_ref, t1_ref,
+                     msel0_ref, msel1_ref, jorder_ref,
+                     out_vals_ref, out_aux_ref, scal_ref, None,
+                     n=n, m=m, P=P, M=M, pg=pg, bf16=bf16)
+
+
 # ---------------------------------------------------------------------------
 # family cycle kernels — streamed (grid over pool tiles, SMEM offset carry)
+# TPU-only: the cross-tile carry needs the sequential grid; `resolve`
+# refuses tiled streaming on the gpu flavor.
 # ---------------------------------------------------------------------------
 #
 # SMEM carry layout (persists across sequential grid steps):
@@ -704,29 +771,30 @@ def _mega_lb2_tiled_kernel(prmu_ref, limit1_ref, valid_ref, best_ref,
 # ---------------------------------------------------------------------------
 
 
-def _cycle_out(M: int, n: int):
+def _cycle_out(M: int, n: int, backend: str = "tpu"):
     """Single-tile out plumbing (grid=(1,) — the pool tile IS the grid)."""
     Mn = M * n
+    full = lambda i: (0, 0)
     shapes = (
         jax.ShapeDtypeStruct((Mn, n), jnp.int32),
         jax.ShapeDtypeStruct((Mn, 1), jnp.int32),
         jax.ShapeDtypeStruct((1, 128), jnp.int32),
     )
     specs = (
-        pl.BlockSpec((Mn, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((Mn, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 128), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        PK._bs((Mn, n), full, backend=backend),
+        PK._bs((Mn, 1), full, backend=backend),
+        PK._bs((1, 128), full, backend=backend),
     )
     return shapes, specs
 
 
-def _chunk_specs(M: int, n: int):
+def _chunk_specs(M: int, n: int, backend: str = "tpu"):
     full = lambda i: (0, 0)
     return [
-        pl.BlockSpec((M, n), full, memory_space=pltpu.VMEM),   # vals
-        pl.BlockSpec((M, 1), full, memory_space=pltpu.VMEM),   # aux
-        pl.BlockSpec((M, 1), full, memory_space=pltpu.VMEM),   # valid
-        pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),  # best
+        PK._bs((M, n), full, backend=backend),   # vals
+        PK._bs((M, 1), full, backend=backend),   # aux
+        PK._bs((M, 1), full, backend=backend),   # valid
+        PK._bs((1,), lambda i: (0,), space="smem", backend=backend),  # best
     ]
 
 
@@ -777,15 +845,18 @@ def _tiled_chunk_specs(mt: int, n: int, two_phase: bool):
 
 
 @lru_cache(maxsize=None)
-def _nqueens_cycle_call(N: int, g: int, M: int, interpret: bool):
-    shapes, out_specs = _cycle_out(M, N)
+def _nqueens_cycle_call(N: int, g: int, M: int, interpret: bool,
+                        backend: str = "tpu"):
+    # The N-Queens cycle body holds no scratch, so the gpu flavor reuses it
+    # verbatim — only the specs/params change spelling.
+    shapes, out_specs = _cycle_out(M, N, backend)
     return pl.pallas_call(
         partial(_mega_nqueens_kernel, N=N, g=g, M=M),
         out_shape=shapes,
         grid=(1,),
-        in_specs=_chunk_specs(M, N),
+        in_specs=_chunk_specs(M, N, backend),
         out_specs=out_specs,
-        compiler_params=PK._compiler_params(),
+        compiler_params=PK._compiler_params(backend=backend),
         interpret=interpret,
     )
 
@@ -806,21 +877,23 @@ def _nqueens_tiled_call(N: int, g: int, M: int, mt: int, interpret: bool):
 
 
 @lru_cache(maxsize=None)
-def _lb1_cycle_call(n: int, m: int, M: int, bf16: bool, interpret: bool):
+def _lb1_cycle_call(n: int, m: int, M: int, bf16: bool, interpret: bool,
+                    backend: str = "tpu"):
     full = lambda i: (0, 0)
-    shapes, out_specs = _cycle_out(M, n)
+    shapes, out_specs = _cycle_out(M, n, backend)
+    kernel = _mega_lb1_kernel_gpu if backend == "gpu" else _mega_lb1_kernel
     return pl.pallas_call(
-        partial(_mega_lb1_kernel, n=n, m=m, M=M, bf16=bf16),
+        partial(kernel, n=n, m=m, M=M, bf16=bf16),
         out_shape=shapes,
         grid=(1,),
-        in_specs=_chunk_specs(M, n) + [
-            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
+        in_specs=_chunk_specs(M, n, backend) + [
+            PK._bs((n, m), full, backend=backend),
+            PK._bs((1, m), full, backend=backend),
+            PK._bs((1, m), full, backend=backend),
         ],
         out_specs=out_specs,
-        scratch_shapes=[pltpu.VMEM((n, M, m), jnp.int32)],
-        compiler_params=PK._compiler_params(),
+        scratch_shapes=PK._scratch(backend, pltpu.VMEM((n, M, m), jnp.int32)),
+        compiler_params=PK._compiler_params(backend=backend),
         interpret=interpret,
     )
 
@@ -851,31 +924,33 @@ def _lb1_tiled_call(n: int, m: int, M: int, mt: int, bf16: bool,
 
 @lru_cache(maxsize=None)
 def _lb2_cycle_call(n: int, m: int, P: int, M: int, pg: int, bf16: bool,
-                    interpret: bool):
+                    interpret: bool, backend: str = "tpu"):
     full = lambda i: (0, 0)
     full3 = lambda i: (0, 0, 0)
-    shapes, out_specs = _cycle_out(M, n)
+    bs = partial(PK._bs, backend=backend)
+    shapes, out_specs = _cycle_out(M, n, backend)
+    kernel = _mega_lb2_kernel_gpu if backend == "gpu" else _mega_lb2_kernel
     return pl.pallas_call(
-        partial(_mega_lb2_kernel, n=n, m=m, P=P, M=M, pg=pg, bf16=bf16),
+        partial(kernel, n=n, m=m, P=P, M=M, pg=pg, bf16=bf16),
         out_shape=shapes,
         grid=(1,),
-        in_specs=_chunk_specs(M, n) + [
-            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
+        in_specs=_chunk_specs(M, n, backend) + [
+            bs((n, m), full),
+            bs((1, m), full),
             # Per-pair table layout matches `_lb2_call` exactly — see the
             # leading-axis / SMEM notes there.
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, n, n), full3, memory_space=pltpu.VMEM),
+            bs((P, 1, n), full3),
+            bs((P, 1, n), full3),
+            bs((P, 1, n), full3),
+            bs((P,), lambda i: (0,), space="smem"),
+            bs((P,), lambda i: (0,), space="smem"),
+            bs((P, 1, m), full3),
+            bs((P, 1, m), full3),
+            bs((P, n, n), full3),
         ],
         out_specs=out_specs,
-        scratch_shapes=[pltpu.VMEM((n, M, m), jnp.int32)],
-        compiler_params=PK._compiler_params(),
+        scratch_shapes=PK._scratch(backend, pltpu.VMEM((n, M, m), jnp.int32)),
+        compiler_params=PK._compiler_params(backend=backend),
         interpret=interpret,
     )
 
@@ -941,6 +1016,9 @@ def make_cycle(problem, M: int, device, decision: Decision):
     tiled = decision.grid > 1
     mt = decision.mt or M
     G = decision.grid
+    # Tiled streaming is TPU-only (resolve refuses it on gpu), so only the
+    # single-tile factories take the flavor.
+    kb = decision.backend
 
     def _legacy(rows, caux, scal):
         zero_offs = jnp.zeros((1,), jnp.int32)
@@ -957,7 +1035,8 @@ def make_cycle(problem, M: int, device, decision: Decision):
             call = _nqueens_tiled_call(problem.N, problem.g, M, mt,
                                        interpret)
         else:
-            call = _nqueens_cycle_call(problem.N, problem.g, M, interpret)
+            call = _nqueens_cycle_call(problem.N, problem.g, M, interpret,
+                                       kb)
 
         def cycle(vals_c, aux_c, valid, best):
             rows, caux, scal = call(
@@ -976,7 +1055,7 @@ def make_cycle(problem, M: int, device, decision: Decision):
         if tiled:
             call = _lb1_tiled_call(n, m, M, mt, bf16, interpret)
         else:
-            call = _lb1_cycle_call(n, m, M, bf16, interpret)
+            call = _lb1_cycle_call(n, m, M, bf16, interpret, kb)
 
         def cycle(vals_c, aux_c, valid, best):
             rows, caux, scal = call(
@@ -1000,7 +1079,7 @@ def make_cycle(problem, M: int, device, decision: Decision):
     if tiled:
         call = _lb2_tiled_call(n, m, Pp, M, mt, pg, bf16, interpret)
     else:
-        call = _lb2_cycle_call(n, m, Pp, M, pg, bf16, interpret)
+        call = _lb2_cycle_call(n, m, Pp, M, pg, bf16, interpret, kb)
 
     def cycle(vals_c, aux_c, valid, best):
         rows, caux, scal = call(
@@ -1054,72 +1133,96 @@ def _eval_lb2_kernel(prmu_ref, limit1_ref, ptm_ref, heads_ref,
     ).astype(jnp.int32)
 
 
+def _eval_lb1_kernel_gpu(prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref,
+                         out_ref, *, n: int, m: int, bf16: bool):
+    _eval_lb1_kernel(prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref,
+                     out_ref, None, n=n, m=m, bf16=bf16)
+
+
+def _eval_lb2_kernel_gpu(prmu_ref, limit1_ref, ptm_ref, heads_ref,
+                         p0_ref, p1_ref, lag_ref, t0_ref, t1_ref,
+                         msel0_ref, msel1_ref, jorder_ref,
+                         out_ref, *, n: int, m: int, P: int, pg: int,
+                         bf16: bool):
+    _eval_lb2_kernel(prmu_ref, limit1_ref, ptm_ref, heads_ref,
+                     p0_ref, p1_ref, lag_ref, t0_ref, t1_ref,
+                     msel0_ref, msel1_ref, jorder_ref,
+                     out_ref, None, n=n, m=m, P=P, pg=pg, bf16=bf16)
+
+
 @lru_cache(maxsize=None)
-def _eval_nqueens_call(N: int, g: int, B: int, mt: int, interpret: bool):
+def _eval_nqueens_call(N: int, g: int, B: int, mt: int, interpret: bool,
+                       backend: str = "tpu"):
     tm = lambda i: (i, 0)
+    bs = partial(PK._bs, backend=backend)
     return pl.pallas_call(
         partial(_eval_nqueens_kernel, N=N, g=g),
         out_shape=jax.ShapeDtypeStruct((B, N), jnp.int32),
         grid=(B // mt,),
-        in_specs=[pl.BlockSpec((mt, N), tm, memory_space=pltpu.VMEM),
-                  pl.BlockSpec((mt, 1), tm, memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((mt, N), tm, memory_space=pltpu.VMEM),
-        compiler_params=PK._compiler_params(parallel=True),
+        in_specs=[bs((mt, N), tm), bs((mt, 1), tm)],
+        out_specs=bs((mt, N), tm),
+        compiler_params=PK._compiler_params(parallel=True, backend=backend),
         interpret=interpret,
     )
 
 
 @lru_cache(maxsize=None)
 def _eval_lb1_call(n: int, m: int, B: int, mt: int, bf16: bool,
-                   interpret: bool):
+                   interpret: bool, backend: str = "tpu"):
     tm = lambda i: (i, 0)
     full = lambda i: (0, 0)
+    bs = partial(PK._bs, backend=backend)
+    kernel = _eval_lb1_kernel_gpu if backend == "gpu" else _eval_lb1_kernel
     return pl.pallas_call(
-        partial(_eval_lb1_kernel, n=n, m=m, bf16=bf16),
+        partial(kernel, n=n, m=m, bf16=bf16),
         out_shape=jax.ShapeDtypeStruct((B, n), jnp.int32),
         grid=(B // mt,),
         in_specs=[
-            pl.BlockSpec((mt, n), tm, memory_space=pltpu.VMEM),
-            pl.BlockSpec((mt, 1), tm, memory_space=pltpu.VMEM),
-            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
+            bs((mt, n), tm),
+            bs((mt, 1), tm),
+            bs((n, m), full),
+            bs((1, m), full),
+            bs((1, m), full),
         ],
-        out_specs=pl.BlockSpec((mt, n), tm, memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((n, mt, m), jnp.int32)],
-        compiler_params=PK._compiler_params(parallel=True),
+        out_specs=bs((mt, n), tm),
+        scratch_shapes=PK._scratch(backend,
+                                   pltpu.VMEM((n, mt, m), jnp.int32)),
+        compiler_params=PK._compiler_params(parallel=True, backend=backend),
         interpret=interpret,
     )
 
 
 @lru_cache(maxsize=None)
 def _eval_lb2_call(n: int, m: int, P: int, B: int, mt: int, pg: int,
-                   bf16: bool, interpret: bool):
+                   bf16: bool, interpret: bool, backend: str = "tpu"):
     tm = lambda i: (i, 0)
     full = lambda i: (0, 0)
     full3 = lambda i: (0, 0, 0)
     smem1 = lambda i: (0,)
+    bs = partial(PK._bs, backend=backend)
+    kernel = _eval_lb2_kernel_gpu if backend == "gpu" else _eval_lb2_kernel
     return pl.pallas_call(
-        partial(_eval_lb2_kernel, n=n, m=m, P=P, pg=pg, bf16=bf16),
+        partial(kernel, n=n, m=m, P=P, pg=pg, bf16=bf16),
         out_shape=jax.ShapeDtypeStruct((B, n), jnp.int32),
         grid=(B // mt,),
         in_specs=[
-            pl.BlockSpec((mt, n), tm, memory_space=pltpu.VMEM),
-            pl.BlockSpec((mt, 1), tm, memory_space=pltpu.VMEM),
-            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P,), smem1, memory_space=pltpu.SMEM),
-            pl.BlockSpec((P,), smem1, memory_space=pltpu.SMEM),
-            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, n, n), full3, memory_space=pltpu.VMEM),
+            bs((mt, n), tm),
+            bs((mt, 1), tm),
+            bs((n, m), full),
+            bs((1, m), full),
+            bs((P, 1, n), full3),
+            bs((P, 1, n), full3),
+            bs((P, 1, n), full3),
+            bs((P,), smem1, space="smem"),
+            bs((P,), smem1, space="smem"),
+            bs((P, 1, m), full3),
+            bs((P, 1, m), full3),
+            bs((P, n, n), full3),
         ],
-        out_specs=pl.BlockSpec((mt, n), tm, memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((n, mt, m), jnp.int32)],
-        compiler_params=PK._compiler_params(parallel=True),
+        out_specs=bs((mt, n), tm),
+        scratch_shapes=PK._scratch(backend,
+                                   pltpu.VMEM((n, mt, m), jnp.int32)),
+        compiler_params=PK._compiler_params(parallel=True, backend=backend),
         interpret=interpret,
     )
 
@@ -1143,18 +1246,19 @@ def streamed_eval_bounds(problem, vals, aux, mt: int | None = None,
         raise ValueError(
             f"streamed_eval_bounds: tile {mt} must divide B={B} and be a "
             "multiple of the sublane quantum (8)")
+    kb = BK.kernel_kind(None)
     if interpret is None:
-        interpret = PK.pallas_interpret() or not _on_tpu(None)
+        interpret = PK.pallas_interpret() or _native_kind(None) is None
     vals_c = jnp.asarray(vals).astype(jnp.int32)
     aux_c = jnp.asarray(aux).astype(jnp.int32)[:, None]
     if fam == "nqueens":
-        call = _eval_nqueens_call(problem.N, problem.g, B, mt, interpret)
+        call = _eval_nqueens_call(problem.N, problem.g, B, mt, interpret, kb)
         return call(vals_c, aux_c)
     t = problem.device_tables()
     n, m = problem.jobs, problem.machines
     bf16 = bool(getattr(t, "exact_bf16", False))
     if fam == "lb1":
-        call = _eval_lb1_call(n, m, B, mt, bf16, interpret)
+        call = _eval_lb1_call(n, m, B, mt, bf16, interpret, kb)
         return call(vals_c, aux_c, t.ptm_t, t.min_heads[None, :],
                     t.min_tails[None, :])
     from . import pfsp_device as PD
@@ -1164,7 +1268,7 @@ def streamed_eval_bounds(problem, vals, aux, mt: int | None = None,
     ordered = (t.johnson_ordered_device(pg) if PK._eager_context()
                else t.johnson_ordered_mp(pg))
     Pp = ordered.lag_o.shape[0]
-    call = _eval_lb2_call(n, m, Pp, B, mt, pg, bf16, interpret)
+    call = _eval_lb2_call(n, m, Pp, B, mt, pg, bf16, interpret, kb)
     return call(vals_c, aux_c, t.ptm_t, t.min_heads[None, :],
                 ordered.p0_o[:, None, :], ordered.p1_o[:, None, :],
                 ordered.lag_o[:, None, :], ordered.tails0, ordered.tails1,

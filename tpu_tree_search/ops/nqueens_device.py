@@ -50,13 +50,17 @@ def make_core(N: int, g: int = 1):
 
 
 def make_labels(N: int, g: int = 1, device=None):
-    """Routed safety evaluator: Pallas kernel when the target device is a
-    TPU (`pallas_kernels.py`), the jnp/XLA core elsewhere. Same contract as
-    ``make_core``."""
+    """Routed safety evaluator: Pallas kernel when the target device
+    natively compiles the resolved kernel flavor (`pallas_kernels.py` /
+    `ops/backend.py` — the gpu flavor also routes forced-interpret), the
+    jnp/XLA core elsewhere. Same contract as ``make_core``."""
+    from . import backend as BK
     from . import pallas_kernels as PK
 
     if PK.use_pallas(device):
-        return lambda board, depth: PK.nqueens_labels(board, depth, N, g)
+        kb = BK.kernel_kind(device)
+        return lambda board, depth: PK.nqueens_labels(board, depth, N, g,
+                                                      backend=kb)
     return make_core(N, g)
 
 
@@ -74,8 +78,11 @@ def make_jitted_core(N: int, g: int = 1, device=None):
     key — flipping TTS_PALLAS / TTS_PALLAS_INTERPRET between searches must
     rebuild, not reuse a stale core (same invariant as
     ``pfsp_device.routing_cache_token``)."""
+    from . import backend as BK
     from . import pallas_kernels as PK
 
     return _make_jitted_core(
-        N, g, device, (PK.use_pallas(device), PK.pallas_interpret())
+        N, g, device,
+        (PK.use_pallas(device), PK.pallas_interpret(),
+         BK.kernel_backend_mode(), BK.kernel_kind(device)),
     )

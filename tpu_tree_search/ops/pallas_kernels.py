@@ -13,9 +13,16 @@ Reference counterparts: `evaluate_gpu` (`nqueens_gpu_chpl.chpl:97-123`) and
 — one SIMT thread per (parent, child); here one grid step per TILE_B parents
 with all children vectorized on the VPU/MXU.
 
-Selection: ``use_pallas()`` returns True on TPU backends unless disabled via
-``TTS_PALLAS=0``; tests force ``interpret=True`` on CPU to check the kernels
-bit-for-bit against the jnp oracles.
+Selection: ``use_pallas()`` consults the kernel-backend seam
+(`ops/backend.py`, ``TTS_KERNEL_BACKEND``) — True on native TPU/GPU
+backends unless disabled via ``TTS_PALLAS=0``; tests force
+``interpret=True`` on CPU to check the kernels bit-for-bit against the jnp
+oracles.  Every factory takes a ``backend`` flavor ("tpu"/"gpu"): the GPU
+flavor lowers the SAME tile bodies through `jax.experimental.pallas.triton`
+— plain BlockSpecs (Triton has no memory spaces), no scratch refs (the
+position-major scan staging statically unrolls instead — `_front_scan`),
+Triton compiler params — and runs under interpret mode on non-GPU
+processes (the CI parity path).
 """
 
 from __future__ import annotations
@@ -28,26 +35,37 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils import jax_compat
+
 
 def use_pallas(device=None) -> bool:
-    """Route to the Pallas kernels only when the *target device* is a TPU.
+    """Route to the Pallas kernels only when the *target device* natively
+    compiles the resolved kernel flavor (`ops/backend.py`).
 
     The reference's dispatcher selects per device context
     (`evaluate.cu:93-119`); keying on the process default backend instead
     breaks any CPU-device execution inside a TPU-default process (e.g. the
     driver's virtual-CPU multichip dryrun). Callers that own a device thread
-    it through; ``None`` falls back to the default backend.
+    it through; ``None`` falls back to the default backend.  A FORCED gpu
+    flavor on a non-GPU process still routes to the kernels — they run
+    under interpret mode (`_default_interpret`), which is how CI proves the
+    Triton-structured lowering bit-exact without a GPU.
     """
     if os.environ.get("TTS_PALLAS", "1") == "0":
         return False
     if pallas_interpret():
         return True
     try:
-        if device is not None:
-            return device.platform == "tpu"
-        return jax.default_backend() == "tpu"
+        from . import backend as BK
+
+        b = BK.resolve_backend(device)
     except Exception:
         return False
+    if b.kind == "jnp":
+        return False
+    if b.kind == "tpu":
+        return b.native
+    return True  # gpu: native compiles Triton; forced runs interpret
 
 
 def pallas_forced() -> bool:
@@ -85,33 +103,76 @@ def pallas_interpret() -> bool:
     return os.environ.get("TTS_PALLAS_INTERPRET", "0") == "1"
 
 
+def _default_interpret(backend: str = "tpu") -> bool:
+    """The interpret default a kernel entry resolves when the caller does
+    not force one: the TTS_PALLAS_INTERPRET knob as always, plus — for the
+    gpu flavor — any process that cannot compile Triton natively (the CI
+    parity path: Triton-structured kernels, interpreted on CPU)."""
+    if pallas_interpret():
+        return True
+    if backend == "gpu":
+        from . import backend as BK
+
+        return not BK.resolve_backend(None).native
+    return False
+
+
 def _round_up(x: int, k: int) -> int:
     return (x + k - 1) // k * k
 
 
-def _vmem_limit_bytes() -> int | None:
-    """Scoped-VMEM ceiling for the PFSP kernels. The Mosaic default (16 MB)
-    rejects the lb-family kernels above tile 64 (the (T, n, n) one-hot and
-    the (n, T, m) scan scratch pad n/m up to the 128-lane tile); v5e has
+def _vmem_limit_bytes(backend: str = "tpu") -> int | None:
+    """Scoped fast-memory ceiling for the PFSP kernels, per backend.
+
+    TPU: the Mosaic scoped-VMEM charge. The Mosaic default (16 MB) rejects
+    the lb-family kernels above tile 64 (the (T, n, n) one-hot and the
+    (n, T, m) scan scratch pad n/m up to the 128-lane tile); v5e has
     128 MB of VMEM, so raising the scope to 96 MB is safe for a standalone
-    pallas_call and lets the batch tile grow to MXU-efficient sizes."""
+    pallas_call and lets the batch tile grow to MXU-efficient sizes.
+
+    GPU: Triton has no compiler-enforced scope — this is the PROVISIONAL
+    per-block working-set ceiling the tile chooser sizes against
+    (``TTS_PALLAS_GPU_MB``, default 64: register file + L1/shared per SM
+    on A100/H100-class parts comfortably covers a 32 MB half-budget
+    working set via L2 residency; re-measure with
+    `scripts/gpu_session.sh`)."""
+    if backend == "gpu":
+        mb = int(os.environ.get("TTS_PALLAS_GPU_MB", "64"))
+        if mb < 0:
+            raise ValueError(
+                f"TTS_PALLAS_GPU_MB must be >= 0 (0 disables), got {mb}")
+        return mb * 2**20 if mb else None
     mb = int(os.environ.get("TTS_PALLAS_VMEM_MB", "96"))
     if mb < 0:
         raise ValueError(f"TTS_PALLAS_VMEM_MB must be >= 0 (0 disables), got {mb}")
     return mb * 2**20 if mb else None
 
 
-def _compiler_params(ndims: int = 1, parallel: bool = False):
-    # CompilerParams was TPUCompilerParams before jax 0.5 (jax_compat-class
-    # rename, handled inline — this module must stay importable without
-    # touching the utils layer). ``ndims`` sizes dimension_semantics to the
-    # grid rank; ``parallel`` marks every grid axis Megacore-splittable
-    # (only safe for carry-free kernels — see megakernel.streamed_eval_bounds).
-    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
-    sem = ("parallel" if parallel else "arbitrary",) * ndims
-    return cls(
-        dimension_semantics=sem, vmem_limit_bytes=_vmem_limit_bytes()
+def _compiler_params(ndims: int = 1, parallel: bool = False,
+                     backend: str = "tpu"):
+    # Backend-keyed compiler params via the jax_compat shim (the version
+    # probe — CompilerParams vs TPUCompilerParams vs TritonCompilerParams —
+    # lives there, never inline here). ``ndims`` sizes dimension_semantics
+    # to the grid rank; ``parallel`` marks every grid axis
+    # Megacore-splittable (only safe for carry-free kernels — see
+    # megakernel.streamed_eval_bounds). Both are TPU-only concepts: the
+    # Triton grid is parallel CUDA blocks unconditionally.
+    return jax_compat.pallas_compiler_params(
+        backend=backend, ndims=ndims, parallel=parallel,
+        vmem_limit_bytes=_vmem_limit_bytes(backend),
     )
+
+
+def _bs(shape, index_map, space: str = "vmem", backend: str = "tpu"):
+    """Backend-keyed BlockSpec (jax_compat shim): memory-space-pinned on
+    TPU, plain on Triton."""
+    return jax_compat.pallas_block_spec(shape, index_map, space=space,
+                                        backend=backend)
+
+
+def _scratch(backend: str, *tpu_shapes):
+    """Backend-keyed scratch_shapes (jax_compat shim): empty on Triton."""
+    return jax_compat.pallas_scratch_shapes(backend, *tpu_shapes)
 
 
 def _env_tile(name: str, default: int) -> int:
@@ -149,22 +210,23 @@ def _model_bytes(t: int, n: int, m: int, extra_bytes: int,
     return tn2 + oh_nt + scan + ptg + chains + extra_bytes
 
 
-def _vmem_budget() -> int:
-    return (_vmem_limit_bytes() or 16 * 2**20) // 2
+def _vmem_budget(backend: str = "tpu") -> int:
+    return (_vmem_limit_bytes(backend) or 16 * 2**20) // 2
 
 
 def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
                tn2_copies: int = 3, pair_copies: int = 0,
-               pair_group: int = 1) -> int:
-    """Shrink the batch tile until the kernel's modeled VMEM footprint fits.
+               pair_group: int = 1, backend: str = "tpu") -> int:
+    """Shrink the batch tile until the kernel's modeled memory footprint
+    fits the backend's budget (`_vmem_limit_bytes`).
 
     The reference rebuilds with bigger compile-time params for large
     instances (`Taillard.chpl:29-52`); here the same kernel covers 20-500
     jobs by trading batch-tile size for job count — the big matmuls keep
     T*n rows, so MXU utilization survives small T at large n. The model
-    (``_model_bytes``) is checked against half the scoped-VMEM budget,
-    halving the tile until it fits (floor 8)."""
-    budget = _vmem_budget()
+    (``_model_bytes``) is checked against half the scoped budget, halving
+    the tile until it fits (floor 8)."""
+    budget = _vmem_budget(backend)
     tile = default
     while tile > 8 and _model_bytes(tile, n, m, extra_bytes, tn2_copies,
                                     pair_copies, pair_group) > budget:
@@ -177,14 +239,14 @@ def _auto_tile(n: int, m: int, default: int, extra_bytes: int = 0,
 
 def _auto_tile_fits(n: int, m: int, default: int, extra_bytes: int = 0,
                     tn2_copies: int = 3, pair_copies: int = 0,
-                    pair_group: int = 1) -> bool:
-    """True iff the kernel fits the VMEM model even at the smallest tile —
-    the routing gate: shapes that do not fit must stay on the jnp path
+                    pair_group: int = 1, backend: str = "tpu") -> bool:
+    """True iff the kernel fits the memory model even at the smallest tile
+    — the routing gate: shapes that do not fit must stay on the jnp path
     instead of dying inside a Mosaic VMEM OOM."""
     tile = _auto_tile(n, m, default, extra_bytes, tn2_copies, pair_copies,
-                      pair_group)
+                      pair_group, backend)
     return _model_bytes(tile, n, m, extra_bytes, tn2_copies, pair_copies,
-                        pair_group) <= _vmem_budget()
+                        pair_group) <= _vmem_budget(backend)
 
 
 def _lb2_static_extra(n: int, m: int, P: int) -> int:
@@ -233,7 +295,8 @@ def _kernel_tile_args(kernel: str, n: int, m: int, P: int | None):
 
 def effective_tile(kernel: str, n: int, m: int, P: int | None = None,
                    batch: int | None = None,
-                   pair_group: int | None = None) -> int:
+                   pair_group: int | None = None,
+                   backend: str = "tpu") -> int:
     """The batch tile a kernel will actually use for shape (n, m[, P]) —
     shared by the feasibility gates, the kernel callers, and
     scripts/tile_sweep.py so the model constants live in exactly one
@@ -241,29 +304,33 @@ def effective_tile(kernel: str, n: int, m: int, P: int | None = None,
     default, extra, copies, pair_copies = _kernel_tile_args(kernel, n, m, P)
     pg = _resolve_pair_group(kernel, n, P, pair_group)
     tile = _auto_tile(n, m, default, extra_bytes=extra, tn2_copies=copies,
-                      pair_copies=pair_copies, pair_group=pg)
+                      pair_copies=pair_copies, pair_group=pg,
+                      backend=backend)
     return tile if batch is None else min(tile, batch)
 
 
 def _kernel_feasible(kernel: str, n: int, m: int, P: int | None,
-                     pair_group: int | None = None) -> bool:
+                     pair_group: int | None = None,
+                     backend: str = "tpu") -> bool:
     default, extra, copies, pair_copies = _kernel_tile_args(kernel, n, m, P)
     pg = _resolve_pair_group(kernel, n, P, pair_group)
     return _auto_tile_fits(n, m, default, extra_bytes=extra,
                            tn2_copies=copies, pair_copies=pair_copies,
-                           pair_group=pg)
+                           pair_group=pg, backend=backend)
 
 
-def lb1_kernel_feasible(n: int, m: int) -> bool:
-    return _kernel_feasible("lb1", n, m, None)
+def lb1_kernel_feasible(n: int, m: int, backend: str = "tpu") -> bool:
+    return _kernel_feasible("lb1", n, m, None, backend=backend)
 
 
-def lb2_kernel_feasible(n: int, m: int, P: int) -> bool:
-    return _kernel_feasible("lb2", n, m, P)
+def lb2_kernel_feasible(n: int, m: int, P: int,
+                        backend: str = "tpu") -> bool:
+    return _kernel_feasible("lb2", n, m, P, backend=backend)
 
 
-def lb2_self_kernel_feasible(n: int, m: int, P: int) -> bool:
-    return _kernel_feasible("lb2self", n, m, P)
+def lb2_self_kernel_feasible(n: int, m: int, P: int,
+                             backend: str = "tpu") -> bool:
+    return _kernel_feasible("lb2self", n, m, P, backend=backend)
 
 
 # ---------------------------------------------------------------------------
@@ -300,7 +367,8 @@ def _nqueens_kernel(board_ref, depth_ref, out_ref, *, N: int, g: int):
 
 
 @lru_cache(maxsize=None)
-def _nqueens_call(N: int, g: int, B: int, tile: int, interpret: bool):
+def _nqueens_call(N: int, g: int, B: int, tile: int, interpret: bool,
+                  backend: str = "tpu"):
     kernel = partial(_nqueens_kernel, N=N, g=g)
     grid = (B // tile,)
     return pl.pallas_call(
@@ -308,26 +376,26 @@ def _nqueens_call(N: int, g: int, B: int, tile: int, interpret: bool):
         out_shape=jax.ShapeDtypeStruct((B, N), jnp.uint8),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tile, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            _bs((tile, N), lambda i: (i, 0), backend=backend),
+            _bs((tile, 1), lambda i: (i, 0), backend=backend),
         ],
-        out_specs=pl.BlockSpec((tile, N), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        compiler_params=_compiler_params(),
+        out_specs=_bs((tile, N), lambda i: (i, 0), backend=backend),
+        compiler_params=_compiler_params(backend=backend),
         interpret=interpret,
     )
 
 
 def nqueens_labels(board, depth, N: int, g: int = 1,
-                   interpret: bool | None = None):
+                   interpret: bool | None = None, backend: str = "tpu"):
     """(B, N) uint8 labels; same contract as `nqueens_device.make_core`."""
-    interpret = pallas_interpret() if interpret is None else interpret
+    interpret = _default_interpret(backend) if interpret is None else interpret
     B = board.shape[0]
     tile = min(512, B)
     Bp = _round_up(B, tile)
     if Bp != B:
         board = jnp.pad(board, ((0, Bp - B), (0, 0)))
         depth = jnp.pad(depth, ((0, Bp - B),))
-    out = _nqueens_call(N, g, Bp, tile, interpret)(
+    out = _nqueens_call(N, g, Bp, tile, interpret, backend)(
         board.astype(jnp.int32), depth.astype(jnp.int32)[:, None]
     )
     return out[:B]
@@ -357,16 +425,58 @@ def _hp_dot(a, b, bf16: bool = False):
     )
 
 
+def _front_scan(prmu, limit1, ptm, scan_ref, n: int, m: int,
+                bf16: bool = False):
+    """The masked schedule_front scan (`c_bound_simple.c:51-69`) over a
+    (T, n) permutation tile — shared by `_tile_parent_state` and the
+    staged self-bound kernel.  Returns the (T, m) int32 front.
+
+    ``scan_ref`` is an (n, T, m) VMEM scratch: Mosaic cannot dynamic_slice
+    a *value* with the traced loop index, but it can dynamically index a
+    Ref on its leading axis — so the scan's per-position processing times
+    are staged there (position-major: the same one-hot trick as the child
+    gather, rows swapped so the reshape lands (n, T, m) without a 3-D
+    transpose) and the fori_loop reads ``scan_ref[i]``.
+
+    ``scan_ref=None`` is the GPU (Triton) lowering: Triton pallas has no
+    scratch memory and cannot lower dynamic indexing of register values
+    either, so the scan unrolls STATICALLY over the n positions — static
+    slices of the position-major value, same math, n-way larger program
+    (n <= 100 by the lb2 routing gate, so the unroll stays bounded)."""
+    T = prmu.shape[0]
+    iota_nT = jax.lax.broadcasted_iota(jnp.int32, (n, T, n), 2)
+    oh_nT = (iota_nT == prmu.T[:, :, None]).astype(jnp.float32)
+    pts = (
+        _hp_dot(oh_nT.reshape(n * T, n), ptm, bf16)
+        .reshape(n, T, m).astype(jnp.int32)
+    )
+
+    def step(i, pt, front):
+        cols = [front[:, 0] + pt[:, 0]]
+        for j in range(1, m):
+            cols.append(jnp.maximum(cols[-1], front[:, j]) + pt[:, j])
+        newf = jnp.stack(cols, axis=-1)
+        return jnp.where((i <= limit1)[:, None], newf, front)
+
+    front0 = jnp.zeros((T, m), jnp.int32)
+    if scan_ref is None:
+        front = front0
+        for i in range(n):  # static unroll — no scratch ref on Triton
+            front = step(i, pts[i], front)
+        return front
+    scan_ref[...] = pts
+    return jax.lax.fori_loop(
+        0, n, lambda i, f: step(i, scan_ref[i], f), front0
+    )
+
+
 def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int,
                        bf16: bool = False):
     """Shared tile prologue of the PFSP bound kernels: the one-hot MXU gather
     of per-position processing times, the masked schedule_front scan
-    (`c_bound_simple.c:51-69`), and the per-child add_forward fronts.
-
-    ``scan_ref`` is an (n, T, m) VMEM scratch: Mosaic cannot dynamic_slice a
-    *value* with the traced loop index, but it can dynamically index a Ref on
-    its leading axis — so the scan's per-position processing times are staged
-    there (position-major) and the fori_loop reads ``scan_ref[i]``.
+    (`_front_scan` — staged through ``scan_ref`` on TPU, statically
+    unrolled when ``scan_ref`` is None on the Triton lowering), and the
+    per-child add_forward fronts.
 
     Returns (onehot, ptg, front, child_front_cols) with child_front_cols a
     list of m (T, n) columns.
@@ -379,24 +489,7 @@ def _tile_parent_state(prmu, limit1, ptm, heads, scan_ref, n: int, m: int,
         .reshape(T, n, m).astype(jnp.int32)
     )
 
-    # Position-major copy for the scan (same one-hot trick, rows swapped so
-    # the reshape lands (n, T, m) without a 3-D transpose).
-    iota_nT = jax.lax.broadcasted_iota(jnp.int32, (n, T, n), 2)
-    oh_nT = (iota_nT == prmu.T[:, :, None]).astype(jnp.float32)
-    scan_ref[...] = (
-        _hp_dot(oh_nT.reshape(n * T, n), ptm, bf16)
-        .reshape(n, T, m).astype(jnp.int32)
-    )
-
-    def scan_step(i, front):
-        pt = scan_ref[i]  # (T, m) — dynamic leading-axis ref read
-        cols = [front[:, 0] + pt[:, 0]]
-        for j in range(1, m):
-            cols.append(jnp.maximum(cols[-1], front[:, j]) + pt[:, j])
-        newf = jnp.stack(cols, axis=-1)
-        return jnp.where((i <= limit1)[:, None], newf, front)
-
-    front = jax.lax.fori_loop(0, n, scan_step, jnp.zeros((T, m), jnp.int32))
+    front = _front_scan(prmu, limit1, ptm, scan_ref, n, m, bf16)
     front = jnp.where((limit1 == -1)[:, None], heads, front)
 
     # Remaining work per machine over the open positions (sum_unscheduled,
@@ -460,10 +553,12 @@ def _lb1_kernel(
 
 @lru_cache(maxsize=None)
 def _lb1_family_call(kernel_fn, n: int, m: int, B: int, tile: int,
-                     interpret: bool, bf16: bool = False):
+                     interpret: bool, bf16: bool = False,
+                     backend: str = "tpu"):
     """Shared pallas_call factory for the lb1-shaped kernels (lb1 / lb1_d):
     same operand layout — (prmu, limit1, ptm, heads, tails) -> (B, n) —
-    same tiling, same scan scratch."""
+    same tiling, same scan scratch (TPU; the gpu flavor passes a
+    scratch-free kernel_fn and declares none — `_front_scan` unrolls)."""
     kernel = partial(kernel_fn, n=n, m=m, bf16=bf16)
     grid = (B // tile,)
     return pl.pallas_call(
@@ -471,36 +566,39 @@ def _lb1_family_call(kernel_fn, n: int, m: int, B: int, tile: int,
         out_shape=jax.ShapeDtypeStruct((B, n), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((n, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            _bs((tile, n), lambda i: (i, 0), backend=backend),
+            _bs((tile, 1), lambda i: (i, 0), backend=backend),
+            _bs((n, m), lambda i: (0, 0), backend=backend),
+            _bs((1, m), lambda i: (0, 0), backend=backend),
+            _bs((1, m), lambda i: (0, 0), backend=backend),
         ],
-        out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
-        compiler_params=_compiler_params(),
+        out_specs=_bs((tile, n), lambda i: (i, 0), backend=backend),
+        scratch_shapes=_scratch(backend, pltpu.VMEM((n, tile, m), jnp.int32)),
+        compiler_params=_compiler_params(backend=backend),
         interpret=interpret,
     )
 
 
 def _lb1_family_bounds(
     kernel_fn, prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool,
-    bf16: bool = False, kernel_name: str = "lb1",
+    bf16: bool = False, kernel_name: str = "lb1", backend: str = "tpu",
 ):
     B, n = prmu.shape
     m = ptm_t.shape[1]
+    if backend == "gpu":
+        kernel_fn = _GPU_KERNELS[kernel_fn]
     # Per-kernel tile defaults are measured, not uniform (_KERNEL_MODEL):
     # Mosaic compile time for the lb1 kernel grows superlinearly with the
     # batch tile (64 -> ~16s, 128 -> >270s on v5e), while lb1_d compiles at
     # 256 in ~50s. Large instances then shrink the tile further until the
     # VMEM model fits.
-    tile = effective_tile(kernel_name, n, m, batch=B)
+    tile = effective_tile(kernel_name, n, m, batch=B, backend=backend)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
         limit1 = jnp.pad(limit1, ((0, Bp - B),))
-    out = _lb1_family_call(kernel_fn, n, m, Bp, tile, interpret, bf16)(
+    out = _lb1_family_call(kernel_fn, n, m, Bp, tile, interpret, bf16,
+                           backend)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
         ptm_t.astype(jnp.int32),
@@ -536,15 +634,36 @@ def _lb1_d_kernel(
     out_ref[:] = jnp.broadcast_to(lb, (T, n))
 
 
+def _lb1_kernel_gpu(prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref,
+                    out_ref, *, n: int, m: int, bf16: bool = False):
+    """The lb1 kernel without its scan scratch — the Triton flavor
+    (`_front_scan` unrolls statically where the TPU kernel staged)."""
+    _lb1_kernel(prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref,
+                out_ref, None, n=n, m=m, bf16=bf16)
+
+
+def _lb1_d_kernel_gpu(prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref,
+                      out_ref, *, n: int, m: int, bf16: bool = False):
+    _lb1_d_kernel(prmu_ref, limit1_ref, ptm_ref, heads_ref, tails_ref,
+                  out_ref, None, n=n, m=m, bf16=bf16)
+
+
+#: TPU kernel body -> its scratch-free Triton twin (`_lb1_family_bounds`).
+_GPU_KERNELS = {
+    _lb1_kernel: _lb1_kernel_gpu,
+    _lb1_d_kernel: _lb1_d_kernel_gpu,
+}
+
+
 def pfsp_lb1_d_bounds(
     prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool | None = None,
-    bf16: bool = False,
+    bf16: bool = False, backend: str = "tpu",
 ):
     """(B, n) int32 lb1_d child bounds; same contract as `_lb1_d_chunk`."""
-    interpret = pallas_interpret() if interpret is None else interpret
+    interpret = _default_interpret(backend) if interpret is None else interpret
     return _lb1_family_bounds(
         _lb1_d_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
-        bf16, kernel_name="lb1d",
+        bf16, kernel_name="lb1d", backend=backend,
     )
 
 
@@ -657,39 +776,58 @@ def _lb2_kernel(
     out_ref[:] = lb.astype(jnp.int32)
 
 
+def _lb2_kernel_gpu(
+    prmu_ref, limit1_ref, ptm_ref, heads_ref,
+    p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
+    out_ref, *, n: int, m: int, P: int, pg: int = 1, bf16: bool = False,
+):
+    """The lb2 kernel without its scan scratch — the Triton flavor.  The
+    pair loop's dynamic leading-axis ref reads stay: a Triton ref is a
+    pointer, and dynamic pointer offsets are the one dynamic indexing form
+    the lowering is built on."""
+    _lb2_kernel(
+        prmu_ref, limit1_ref, ptm_ref, heads_ref,
+        p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref,
+        jorder_ref, out_ref, None, n=n, m=m, P=P, pg=pg, bf16=bf16,
+    )
+
+
 @lru_cache(maxsize=None)
 def _lb2_call(n: int, m: int, P: int, B: int, tile: int, interpret: bool,
-              bf16: bool = False, pg: int = 1):
-    kernel = partial(_lb2_kernel, n=n, m=m, P=P, pg=pg, bf16=bf16)
+              bf16: bool = False, pg: int = 1, backend: str = "tpu"):
+    kernel_fn = _lb2_kernel_gpu if backend == "gpu" else _lb2_kernel
+    kernel = partial(kernel_fn, n=n, m=m, P=P, pg=pg, bf16=bf16)
     grid = (B // tile,)
     full = lambda i: (0, 0)
     full3 = lambda i: (0, 0, 0)
+    bs = partial(_bs, backend=backend)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B, n), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, m), full, memory_space=pltpu.VMEM),
+            bs((tile, n), lambda i: (i, 0)),
+            bs((tile, 1), lambda i: (i, 0)),
+            bs((n, m), full),
+            bs((1, m), full),
             # Per-pair tables as (P, 1, n)/(P, 1, m): leading-axis dynamic
             # ref reads (see pair_body).
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
+            bs((P, 1, n), full3),
+            bs((P, 1, n), full3),
+            bs((P, 1, n), full3),
             # Per-pair scalars read with a dynamic index: SMEM (Mosaic cannot
-            # dynamically index 1-D VMEM along the lane dim).
-            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
+            # dynamically index 1-D VMEM along the lane dim; Triton has no
+            # memory spaces — the shim drops the pin there).
+            bs((P,), lambda i: (0,), space="smem"),
+            bs((P,), lambda i: (0,), space="smem"),
             # (P, 1, m) one-hot machine selectors (rows read per pair).
-            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, n, n), full3, memory_space=pltpu.VMEM),
+            bs((P, 1, m), full3),
+            bs((P, 1, m), full3),
+            bs((P, n, n), full3),
         ],
-        out_specs=pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
-        compiler_params=_compiler_params(),
+        out_specs=bs((tile, n), lambda i: (i, 0)),
+        scratch_shapes=_scratch(backend, pltpu.VMEM((n, tile, m), jnp.int32)),
+        compiler_params=_compiler_params(backend=backend),
         interpret=interpret,
     )
 
@@ -707,12 +845,12 @@ def _eager_context() -> bool:
 
 def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool | None = None,
                     bf16: bool | None = None,
-                    pair_group: int | None = None):
+                    pair_group: int | None = None, backend: str = "tpu"):
     """(B, n) int32 lb2 child bounds; same contract as `_lb2_chunk`.
     ``pair_group``: pair-group unroll per grid step (None resolves the
     shared TTS_LB2_PAIRBLOCK knob); the pair tables are padded to a
     multiple of it with copies of pair 0 (max is idempotent)."""
-    interpret = pallas_interpret() if interpret is None else interpret
+    interpret = _default_interpret(backend) if interpret is None else interpret
     if bf16 is None:
         bf16 = getattr(tables, "exact_bf16", False)
     B, n = prmu.shape
@@ -721,7 +859,8 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool | None = None,
     pg = _resolve_pair_group("lb2", n, P, pair_group)
     # Tile-independent residents (per-pair tables) + the shared + per-pair
     # (T, n, n)-class live f32 pair-loop values — see _KERNEL_MODEL["lb2"].
-    tile = effective_tile("lb2", n, m, P, batch=B, pair_group=pg)
+    tile = effective_tile("lb2", n, m, P, batch=B, pair_group=pg,
+                          backend=backend)
     Bp = _round_up(B, tile)
     if Bp != B:
         prmu = jnp.pad(prmu, ((0, Bp - B), (0, 0)))
@@ -733,7 +872,7 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool | None = None,
     ordered = (tables.johnson_ordered_device(pg) if _eager_context()
                else tables.johnson_ordered_mp(pg))
     Pp = ordered.lag_o.shape[0]
-    out = _lb2_call(n, m, Pp, Bp, tile, interpret, bf16, pg)(
+    out = _lb2_call(n, m, Pp, Bp, tile, interpret, bf16, pg, backend)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
         tables.ptm_t,
@@ -752,13 +891,13 @@ def pfsp_lb2_bounds(prmu, limit1, tables, interpret: bool | None = None,
 
 def pfsp_lb1_bounds(
     prmu, limit1, ptm_t, min_heads, min_tails, interpret: bool | None = None,
-    bf16: bool = False,
+    bf16: bool = False, backend: str = "tpu",
 ):
     """(B, n) int32 lb1 child bounds; same contract as `_lb1_chunk`."""
-    interpret = pallas_interpret() if interpret is None else interpret
+    interpret = _default_interpret(backend) if interpret is None else interpret
     return _lb1_family_bounds(
         _lb1_kernel, prmu, limit1, ptm_t, min_heads, min_tails, interpret,
-        bf16,
+        bf16, backend=backend,
     )
 
 
@@ -790,26 +929,11 @@ def _lb2_self_kernel(
         T = prmu.shape[0]
         hp = _hp_dot
 
-        # schedule_front via the position-major scan staging (see
-        # _tile_parent_state for why the scratch ref is required).
-        iota_nT = jax.lax.broadcasted_iota(jnp.int32, (n, T, n), 2)
-        oh_nT = (iota_nT == prmu.T[:, :, None]).astype(jnp.float32)
-        scan_ref[...] = (
-            hp(oh_nT.reshape(n * T, n), ptm, bf16)
-            .reshape(n, T, m).astype(jnp.int32)
-        )
-
-        def scan_step(i, front):
-            pt = scan_ref[i]
-            cols = [front[:, 0] + pt[:, 0]]
-            for j in range(1, m):
-                cols.append(jnp.maximum(cols[-1], front[:, j]) + pt[:, j])
-            newf = jnp.stack(cols, axis=-1)
-            return jnp.where((i <= limit1)[:, None], newf, front)
-
-        front = jax.lax.fori_loop(
-            0, n, scan_step, jnp.zeros((T, m), jnp.int32)
-        ).astype(jnp.float32)
+        # schedule_front via the position-major scan staging (`_front_scan`
+        # — scratch-staged on TPU, statically unrolled when scan_ref is
+        # None on the Triton lowering).
+        front = _front_scan(prmu, limit1, ptm, scan_ref, n, m,
+                            bf16).astype(jnp.float32)
 
         # Free flags by job id.
         jobs_iota = jax.lax.broadcasted_iota(jnp.int32, (T, n, n), 2)
@@ -864,35 +988,52 @@ def _lb2_self_kernel(
         out_ref[:] = lb.astype(jnp.int32)
 
 
+def _lb2_self_kernel_gpu(
+    prmu_ref, limit1_ref, nact_ref, ptm_ref,
+    p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref, jorder_ref,
+    out_ref, *, n: int, m: int, P: int, tile: int, pg: int = 1,
+    bf16: bool = False,
+):
+    """The staged self-bound kernel without its scan scratch — the Triton
+    flavor (tile skipping via `pl.when` is backend-neutral)."""
+    _lb2_self_kernel(
+        prmu_ref, limit1_ref, nact_ref, ptm_ref,
+        p0_ref, p1_ref, lag_ref, t0_ref, t1_ref, msel0_ref, msel1_ref,
+        jorder_ref, out_ref, None, n=n, m=m, P=P, tile=tile, pg=pg,
+        bf16=bf16,
+    )
+
+
 @lru_cache(maxsize=None)
 def _lb2_self_call(n: int, m: int, P: int, R: int, tile: int, interpret: bool,
-                   bf16: bool = False, pg: int = 1):
-    kernel = partial(_lb2_self_kernel, n=n, m=m, P=P, tile=tile, pg=pg,
-                     bf16=bf16)
+                   bf16: bool = False, pg: int = 1, backend: str = "tpu"):
+    kernel_fn = _lb2_self_kernel_gpu if backend == "gpu" else _lb2_self_kernel
+    kernel = partial(kernel_fn, n=n, m=m, P=P, tile=tile, pg=pg, bf16=bf16)
     grid = (R // tile,)
     full = lambda i: (0, 0)
     full3 = lambda i: (0, 0, 0)
+    bs = partial(_bs, backend=backend)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((R, 1), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tile, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((n, m), full, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, n), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((P,), lambda i: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, 1, m), full3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, n, n), full3, memory_space=pltpu.VMEM),
+            bs((tile, n), lambda i: (i, 0)),
+            bs((tile, 1), lambda i: (i, 0)),
+            bs((1,), lambda i: (0,), space="smem"),
+            bs((n, m), full),
+            bs((P, 1, n), full3),
+            bs((P, 1, n), full3),
+            bs((P, 1, n), full3),
+            bs((P,), lambda i: (0,), space="smem"),
+            bs((P,), lambda i: (0,), space="smem"),
+            bs((P, 1, m), full3),
+            bs((P, 1, m), full3),
+            bs((P, n, n), full3),
         ],
-        out_specs=pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((n, tile, m), jnp.int32)],
-        compiler_params=_compiler_params(),
+        out_specs=bs((tile, 1), lambda i: (i, 0)),
+        scratch_shapes=_scratch(backend, pltpu.VMEM((n, tile, m), jnp.int32)),
+        compiler_params=_compiler_params(backend=backend),
         interpret=interpret,
     )
 
@@ -917,13 +1058,14 @@ class _PaddedOrdered:
 def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
                                 interpret: bool | None = None,
                                 bf16: bool = False,
-                                pair_group: int | None = None):
+                                pair_group: int | None = None,
+                                backend: str = "tpu"):
     """`pfsp_lb2_self_bounds` over EXPLICIT ordered tables (possibly traced
     slices of the full pair set — the mp-sharded staged path slices each
     shard's contiguous pair block before the call; pallas_call takes traced
     operands like any other op). ``ordered`` needs p0_o/p1_o/lag_o (P, n),
     tails0/tails1 (P,), msel0/msel1 (P, m), jorder (P, n, n)."""
-    interpret = pallas_interpret() if interpret is None else interpret
+    interpret = _default_interpret(backend) if interpret is None else interpret
     R, n = prmu.shape
     m = ptm_t.shape[1]
     P = ordered.lag_o.shape[0]
@@ -931,12 +1073,14 @@ def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
     reps = _round_up(P, pg) - P
     if reps:
         ordered = _PaddedOrdered(ordered, reps)
-    tile = effective_tile("lb2self", n, m, P, batch=R, pair_group=pg)
+    tile = effective_tile("lb2self", n, m, P, batch=R, pair_group=pg,
+                          backend=backend)
     Rp = _round_up(R, tile)
     if Rp != R:
         prmu = jnp.pad(prmu, ((0, Rp - R), (0, 0)))
         limit1 = jnp.pad(limit1, ((0, Rp - R),))
-    out = _lb2_self_call(n, m, P + reps, Rp, tile, interpret, bf16, pg)(
+    out = _lb2_self_call(n, m, P + reps, Rp, tile, interpret, bf16, pg,
+                         backend)(
         prmu.astype(jnp.int32),
         limit1.astype(jnp.int32)[:, None],
         jnp.asarray(n_active, dtype=jnp.int32).reshape(1),
@@ -956,7 +1100,8 @@ def pfsp_lb2_self_bounds_tables(prmu, limit1, n_active, ptm_t, ordered,
 def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
                          interpret: bool | None = None,
                          bf16: bool | None = None,
-                         pair_group: int | None = None):
+                         pair_group: int | None = None,
+                         backend: str = "tpu"):
     """(R,) int32 self lb2 bounds; rows >= n_active are garbage (their
     tiles are skipped entirely). Same contract as `_lb2_self_chunk` on the
     first n_active rows."""
@@ -970,5 +1115,5 @@ def pfsp_lb2_self_bounds(prmu, limit1, n_active, tables,
                else tables.johnson_ordered_mp(pg))
     return pfsp_lb2_self_bounds_tables(
         prmu, limit1, n_active, tables.ptm_t, ordered, interpret, bf16,
-        pair_group=pg,
+        pair_group=pg, backend=backend,
     )

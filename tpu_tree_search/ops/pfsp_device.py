@@ -453,11 +453,12 @@ def lb1_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     # jnp path measured ~7x faster in-kernel on chip (docs/HW_VALIDATION.md
     # decision record); TTS_PALLAS=force re-arms it for the A/B.
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    kb = _kernel_kind(device)
     if (PK.use_pallas(device) and PK.lb1_pallas_enabled() and n <= 512
-            and PK.lb1_kernel_feasible(n, m)):
+            and PK.lb1_kernel_feasible(n, m, backend=kb)):
         return PK.pfsp_lb1_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
-            bf16=tables.exact_bf16,
+            bf16=tables.exact_bf16, backend=kb,
         )
     return _lb1_chunk(prmu, limit1, tables.ptm_t, tables.min_heads,
                       tables.min_tails, bf16=tables.exact_bf16)
@@ -469,16 +470,27 @@ def lb1_d_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     from . import pallas_kernels as PK
 
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
+    kb = _kernel_kind(device)
     if (PK.use_pallas(device) and PK.lb1_pallas_enabled() and n <= 512
-            and PK.lb1_kernel_feasible(n, m)):
+            and PK.lb1_kernel_feasible(n, m, backend=kb)):
         return PK.pfsp_lb1_d_bounds(
             prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
-            bf16=tables.exact_bf16,
+            bf16=tables.exact_bf16, backend=kb,
         )
     return _lb1_d_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
         bf16=tables.exact_bf16,
     )
+
+
+def _kernel_kind(device=None) -> str:
+    """The kernel flavor the seam resolves for this device
+    (`ops/backend.kernel_kind`) — 'gpu' only when the resolved backend is
+    gpu, else the TPU flavor of record (so off-gpu routing stays
+    byte-identical)."""
+    from . import backend as BK
+
+    return BK.kernel_kind(device)
 
 
 def _lb2_pallas_enabled() -> bool:
@@ -493,14 +505,27 @@ def _lb2_pallas_enabled() -> bool:
     return os.environ.get("TTS_PALLAS_LB2", "1") != "0"
 
 
-def _auto_pairblock(P: int, n: int) -> int:
+def _auto_pairblock(P: int, n: int, backend: str | None = None) -> int:
     """Auto pair-block policy: the largest power-of-two block whose
     per-(row, child) working set stays near ~2048 ordered-slot lanes
     (``Pb * n``), clamped to the pair count. At the published shapes this
     gives Pb = P at ta014 (n=20, P=45 — a single block, loop-free) and
     Pb = 64 at ta021 (P=190 — three unrolled blocks); 500-job instances
-    fall to Pb = 4 so the (B, n, Pb, n) intermediates keep fitting."""
-    per = max(4, 2048 // max(1, n))
+    fall to Pb = 4 so the (B, n, Pb, n) intermediates keep fitting.
+
+    The gpu row halves the lane target (~1024): the Triton kernels hold
+    the per-pair group's live values in registers/shared memory per CUDA
+    block rather than a chip-wide VMEM, and the reference tunes its pair
+    batching to that budget (arXiv 2012.09511). PROVISIONAL until a GPU
+    session banks measured rows. ``backend=None`` resolves the seam
+    (`ops/backend.policy_backend`) — off-gpu this is the 2048 row
+    verbatim."""
+    if backend is None:
+        from . import backend as BK
+
+        backend = BK.policy_backend(None)
+    lanes = 1024 if backend == "gpu" else 2048
+    per = max(4, lanes // max(1, n))
     pb = 4
     # tts-lint: waive tracer-branch -- pure host policy on Python ints; P and n are static shapes at every call site (traced callers resolve the knob before tracing)
     while pb * 2 <= per:
@@ -508,19 +533,20 @@ def _auto_pairblock(P: int, n: int) -> int:
     return max(1, min(P, pb))
 
 
-def lb2_pairblock(P: int, n: int) -> int:
+def lb2_pairblock(P: int, n: int, backend: str | None = None) -> int:
     """Resolved lb2 pair-block size for a (P pairs, n jobs) shape.
 
     ``TTS_LB2_PAIRBLOCK`` / ``--lb2-pairblock``: ``auto`` (default) applies
-    `_auto_pairblock`; an explicit positive integer forces the block size
-    (``1`` = the serial per-pair fori_loop, the pre-blocking behavior;
-    values above P clamp to P). Baked into compiled programs at trace
-    time, so `routing_cache_token` carries the resolved value."""
+    `_auto_pairblock` (backend-keyed — see its gpu row); an explicit
+    positive integer forces the block size (``1`` = the serial per-pair
+    fori_loop, the pre-blocking behavior; values above P clamp to P).
+    Baked into compiled programs at trace time, so `routing_cache_token`
+    carries the resolved value."""
     import os
 
     knob = os.environ.get("TTS_LB2_PAIRBLOCK", "auto")
     if knob == "auto":
-        return _auto_pairblock(P, n)
+        return _auto_pairblock(P, n, backend)
     try:
         v = int(knob)
     except ValueError:
@@ -536,13 +562,13 @@ def lb2_pairblock(P: int, n: int) -> int:
     return min(v, P)
 
 
-def lb2_kernel_pair_group(P: int, n: int) -> int:
+def lb2_kernel_pair_group(P: int, n: int, backend: str | None = None) -> int:
     """Pair-group unroll of the Pallas lb2 kernels: the same knob, capped
     at 8 — the kernel VMEM model charges the per-pair live values once per
     unrolled group member (`pallas_kernels._model_bytes`), and 8 is the
     largest group whose modeled footprint keeps MXU-efficient batch tiles
     at the published shapes."""
-    return min(lb2_pairblock(P, n), 8)
+    return min(lb2_pairblock(P, n, backend), 8)
 
 
 def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
@@ -555,10 +581,12 @@ def lb2_bounds(prmu, limit1, tables: "PFSPDeviceTables", device=None):
     # (ta031-ta090); beyond that the jnp path has the same asymptotic cost.
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
+    kb = _kernel_kind(device)
     if (PK.use_pallas(device) and _lb2_pallas_enabled() and n <= 100
-            and PK.lb2_kernel_feasible(n, m, P)):
+            and PK.lb2_kernel_feasible(n, m, P, backend=kb)):
         return PK.pfsp_lb2_bounds(
-            prmu, limit1, tables, pair_group=lb2_kernel_pair_group(P, n)
+            prmu, limit1, tables,
+            pair_group=lb2_kernel_pair_group(P, n, kb), backend=kb,
         )
     return _lb2_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
@@ -663,11 +691,12 @@ def lb2_self_bounds(prmu, limit1, n_active, tables: "PFSPDeviceTables",
 
     n, m = prmu.shape[-1], tables.ptm_t.shape[1]
     P = tables.pairs.shape[0]
+    kb = _kernel_kind(device)
     if (PK.use_pallas(device) and _lb2_pallas_enabled() and n <= 100
-            and PK.lb2_self_kernel_feasible(n, m, P)):
+            and PK.lb2_self_kernel_feasible(n, m, P, backend=kb)):
         return PK.pfsp_lb2_self_bounds(
             prmu, limit1, n_active, tables,
-            pair_group=lb2_kernel_pair_group(P, n),
+            pair_group=lb2_kernel_pair_group(P, n, kb), backend=kb,
         )
     return _lb2_self_chunk(
         prmu, limit1, tables.ptm_t, tables.min_heads, tables.min_tails,
@@ -710,15 +739,16 @@ def lb2_self_bounds_mp(prmu, limit1, n_active, tables: "PFSPDeviceTables",
     pairs, lags, scheds = tables.mp_padded(mp_size)
     P_local = pairs.shape[0] // mp_size
     start = idx * P_local
+    kb = _kernel_kind(device)
     if (PK.use_pallas(device) and _lb2_pallas_enabled() and n <= 100
-            and PK.lb2_self_kernel_feasible(n, m, P_local)):
+            and PK.lb2_self_kernel_feasible(n, m, P_local, backend=kb)):
         ordered = tables.johnson_ordered_mp(mp_size)
         assert ordered.lag_o.shape[0] == pairs.shape[0]
         sliced = _OrderedSlice(ordered, start, P_local)
         local = PK.pfsp_lb2_self_bounds_tables(
             prmu, limit1, n_active, tables.ptm_t, sliced,
             bf16=tables.exact_bf16,
-            pair_group=lb2_kernel_pair_group(P_local, n),
+            pair_group=lb2_kernel_pair_group(P_local, n, kb), backend=kb,
         )
     else:
         prs = jax.lax.dynamic_slice_in_dim(pairs, start, P_local, axis=0)
@@ -772,10 +802,15 @@ def routing_cache_token(problem, device=None) -> tuple:
     silently reusing a stale program. One definition — used by both the
     resident and mesh-resident cache keys."""
     from ..problems.base import narrow_mode
+    from . import backend as BK
     from . import pallas_kernels as PK
     from .megakernel import megakernel_mode
 
     tok: tuple = (PK.use_pallas(device), PK.pallas_interpret(),
+                  # Kernel-backend seam (ops/backend.py): the raw knob and
+                  # the flavor it resolves to — a TTS_KERNEL_BACKEND flip
+                  # rebuilds instead of reusing the other flavor's program.
+                  BK.kernel_backend_mode(), BK.kernel_kind(device),
                   # lb1-family demotion override (TTS_PALLAS=force) is a
                   # trace-time routing decision like the rest.
                   PK.pallas_forced(),

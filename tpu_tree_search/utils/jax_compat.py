@@ -45,3 +45,63 @@ def pcast_varying(x, axis_name: str):
     if pcast is None:
         return x
     return pcast(x, (axis_name,), to="varying")
+
+
+# -- pallas backend shims (ops/backend.py seam) -----------------------------
+# The kernel modules never probe versions or platforms inline: every
+# TPU-only pallas construct (CompilerParams + dimension_semantics + the
+# scoped-VMEM charge, memory-space BlockSpecs, scratch refs) routes
+# through these three wrappers, which also know the Triton spellings.
+
+
+def pallas_compiler_params(backend: str = "tpu", ndims: int = 1,
+                           parallel: bool = False,
+                           vmem_limit_bytes: int | None = None):
+    """Backend-keyed ``pallas_call`` compiler params.
+
+    TPU: ``pltpu.CompilerParams`` (``TPUCompilerParams`` before jax 0.5)
+    with ``dimension_semantics`` sized to the grid rank (``parallel``
+    marks every axis Megacore-splittable — carry-free kernels only) and
+    the scoped-VMEM ceiling.  GPU: ``TritonCompilerParams`` at its
+    defaults — Triton has no dimension semantics (every grid program is a
+    parallel CUDA block) and no VMEM scope; the shared-memory budget is a
+    policy-table concern (`pallas_kernels._vmem_limit_bytes`), not a
+    compiler param.  Returns None when the flavor's module is absent
+    (``pallas_call`` treats that as defaults)."""
+    if backend == "gpu":
+        try:
+            from jax.experimental.pallas import triton as plgpu
+        except Exception:
+            return None
+        cls = (getattr(plgpu, "CompilerParams", None)
+               or getattr(plgpu, "TritonCompilerParams", None))
+        return cls() if cls is not None else None
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    sem = ("parallel" if parallel else "arbitrary",) * ndims
+    return cls(dimension_semantics=sem, vmem_limit_bytes=vmem_limit_bytes)
+
+
+def pallas_block_spec(shape, index_map, space: str = "vmem",
+                      backend: str = "tpu"):
+    """Backend-keyed BlockSpec: the TPU flavor pins the block to VMEM or
+    SMEM (``space``); the Triton flavor has no memory spaces at all —
+    every operand is a plain pointer-backed ref, including the per-pair
+    scalar tables the TPU kernels must stage in SMEM."""
+    from jax.experimental import pallas as pl
+
+    if backend == "gpu":
+        return pl.BlockSpec(shape, index_map)
+    from jax.experimental.pallas import tpu as pltpu
+
+    ms = pltpu.SMEM if space == "smem" else pltpu.VMEM
+    return pl.BlockSpec(shape, index_map, memory_space=ms)
+
+
+def pallas_scratch_shapes(backend: str, *tpu_shapes):
+    """The ``scratch_shapes`` a kernel may declare: the given TPU scratch
+    allocations on the TPU flavor, NONE on Triton (scratch memory is not
+    implemented in the Triton lowering — the kernels restructure instead:
+    `pallas_kernels._front_scan` unrolls what the scratch ref staged)."""
+    return [] if backend == "gpu" else list(tpu_shapes)
